@@ -1,0 +1,20 @@
+"""grapevine_tpu: a TPU-native oblivious message bus framework.
+
+A ground-up rebuild of the capabilities of mobilecoinofficial/grapevine
+(reference: an SGX-enclave CRUD message broker over MobileCoin's Path-ORAM,
+see /root/reference/README.md:9-16) designed for TPU hardware:
+
+- the oblivious storage engine is a batched, branchless, jit-compiled
+  Path-ORAM over an HBM-resident SoA bucket tree (``grapevine_tpu.oram``),
+- CRUD semantics run as a uniform masked access sequence so that
+  Read/Update/Delete are indistinguishable in the device access transcript
+  (reference spec: grapevine.proto:120-122),
+- the session layer (noise-style channel, ChaCha20 challenge RNG,
+  ristretto/Schnorr request signatures) runs host-side
+  (``grapevine_tpu.session``),
+- scaling across chips uses a jax.sharding Mesh with the record space
+  partitioned per-chip and responses gathered over ICI
+  (``grapevine_tpu.parallel``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Testing support: seeded fixtures and CPU reference models.
+
+The reference tests everything against deterministic seeded RNGs
+(``get_seeded_rng`` / ``run_with_several_seeds``, reference
+api/tests/grapevine_types.rs:8-9) and validates the oblivious engine
+against plain in-memory models; this package provides the analogs.
+"""

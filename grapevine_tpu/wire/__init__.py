@@ -1,0 +1,10 @@
+"""Wire layer: protocol constants, typed records, and the two codecs.
+
+Corresponds to the reference's ``api/`` + ``types/`` crates (reference
+api/src/lib.rs, types/src/lib.rs). See :mod:`grapevine_tpu.wire.records`
+for the fixed-layout channel codec and :mod:`grapevine_tpu.wire.protowire`
+for the protobuf-wire conformance codec.
+"""
+
+from .constants import *  # noqa: F401,F403
+from .records import QueryRequest, QueryResponse, Record, RequestRecord  # noqa: F401

@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+The analog of the reference's ``SGX_MODE=SW`` simulation testing
+(reference .github/workflows/ci.yaml:15-16): tests never require real TPU
+hardware. Multi-chip sharding tests run against
+``--xla_force_host_platform_device_count=8``.
+
+Must run before anything imports jax, hence the env mutation at module
+import time (pytest imports conftest first).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,153 @@
+"""Wire-discipline tests, mirroring the reference's conformance suite.
+
+The reference proves (a) its two type stacks round-trip byte-for-byte and
+(b) every random request/response encodes at the identical byte length —
+the serialization-layer obliviousness property
+(reference api/tests/grapevine_types.rs:13-55). Here the two stacks are the
+fixed-layout channel codec (wire/records.py) and the protobuf-wire codec
+(wire/protowire.py).
+"""
+
+import pytest
+
+from grapevine_tpu.testing import fixtures as fx
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire import protowire as pw
+from grapevine_tpu.wire.records import QueryRequest, QueryResponse, Record, RequestRecord
+
+
+def test_query_request_round_trip_fixed():
+    fx.run_with_several_seeds(
+        lambda rng: _assert_rt_fixed(fx.random_query_request(rng))
+    )
+
+
+def _assert_rt_fixed(req: QueryRequest):
+    assert QueryRequest.unpack(req.pack()) == req
+
+
+def test_query_response_round_trip_fixed():
+    def check(rng):
+        resp = fx.random_query_response(rng)
+        assert QueryResponse.unpack(resp.pack()) == resp
+
+    fx.run_with_several_seeds(check)
+
+
+def test_query_request_round_trip_protowire():
+    """The two codec stacks agree on every random instance."""
+
+    def check(rng):
+        req = fx.random_query_request(rng)
+        assert pw.decode_query_request(pw.encode_query_request(req)) == req
+        # cross-stack: fixed-layout round trip composed with protowire round
+        # trip yields the same object
+        assert pw.decode_query_request(
+            pw.encode_query_request(QueryRequest.unpack(req.pack()))
+        ) == req
+
+    fx.run_with_several_seeds(check)
+
+
+def test_query_response_round_trip_protowire():
+    def check(rng):
+        resp = fx.random_query_response(rng)
+        assert pw.decode_query_response(pw.encode_query_response(resp)) == resp
+
+    fx.run_with_several_seeds(check)
+
+
+def test_query_request_constant_size():
+    """Every valid request is byte-identical in length on both codecs."""
+    rng = fx.get_seeded_rng()
+    expected_fixed = len(fx.random_query_request(rng).pack())
+    rng = fx.get_seeded_rng()
+    expected_proto = len(pw.encode_query_request(fx.random_query_request(rng)))
+
+    def check(rng):
+        req = fx.random_query_request(rng)
+        assert len(req.pack()) == expected_fixed == C.QUERY_REQUEST_WIRE_SIZE
+        assert len(pw.encode_query_request(req)) == expected_proto
+
+    fx.run_with_several_seeds(check, n_seeds=16)
+
+
+def test_query_response_constant_size():
+    rng = fx.get_seeded_rng()
+    expected_fixed = len(fx.random_query_response(rng).pack())
+    rng = fx.get_seeded_rng()
+    expected_proto = len(pw.encode_query_response(fx.random_query_response(rng)))
+
+    def check(rng):
+        resp = fx.random_query_response(rng)
+        assert len(resp.pack()) == expected_fixed == C.QUERY_RESPONSE_WIRE_SIZE
+        assert len(pw.encode_query_response(resp)) == expected_proto
+
+    fx.run_with_several_seeds(check, n_seeds=16)
+
+
+def test_zero_payload_still_constant_size():
+    """All-zero (but full-length) byte fields must not shrink the encoding."""
+    rng = fx.get_seeded_rng()
+    req = fx.random_query_request(rng)
+    req.record.payload = b"\x00" * C.PAYLOAD_SIZE
+    req.record.msg_id = C.ZERO_MSG_ID
+    assert len(pw.encode_query_request(req)) == len(
+        pw.encode_query_request(fx.random_query_request(fx.get_seeded_rng(3)))
+    )
+    assert len(req.pack()) == C.QUERY_REQUEST_WIRE_SIZE
+
+
+def test_request_type_enum_values():
+    """Constants match the reference RequestType enum (grapevine.proto:44-55)."""
+    assert C.REQUEST_TYPE_INVALID == 0
+    assert C.REQUEST_TYPE_CREATE == 1
+    assert C.REQUEST_TYPE_READ == 2
+    assert C.REQUEST_TYPE_UPDATE == 3
+    assert C.REQUEST_TYPE_DELETE == 4
+
+
+def test_status_code_enum_values():
+    """Constants match the reference StatusCode enum (grapevine.proto:178-197)."""
+    assert C.STATUS_CODE_INVALID == 0
+    assert C.STATUS_CODE_SUCCESS == 1
+    assert C.STATUS_CODE_NOT_FOUND == 2
+    assert C.STATUS_CODE_MESSAGE_ID_ALREADY_IN_USE == 3
+    assert C.STATUS_CODE_INVALID_RECIPIENT == 4
+    assert C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT == 5
+    assert C.STATUS_CODE_TOO_MANY_RECIPIENTS == 6
+    assert C.STATUS_CODE_TOO_MANY_MESSAGES == 7
+    assert C.STATUS_CODE_INTERNAL_ERROR == 8
+
+
+def test_record_geometry():
+    """1024-byte record layout (reference README.md:132-136)."""
+    assert C.RECORD_SIZE == 1024
+    assert C.PAYLOAD_SIZE == 936
+    assert C.MAILBOX_CAP == 62
+    r = fx.random_record(fx.get_seeded_rng())
+    packed = r.pack()
+    assert len(packed) == 1024
+    assert packed[:16] == r.msg_id
+    assert packed[16:48] == r.sender
+    assert packed[48:80] == r.recipient
+    assert packed[88:] == r.payload
+
+
+def test_validation_rejects_bad_lengths():
+    with pytest.raises(ValueError):
+        RequestRecord(msg_id=b"\x00" * 15).validate()
+    with pytest.raises(ValueError):
+        Record(payload=b"\x00" * 935).validate()
+    with pytest.raises(ValueError):
+        QueryRequest(auth_signature=b"\x00" * 63).validate()
+
+
+def test_outer_envelope_round_trip():
+    m = pw.EnvelopeMessage(aad=b"a", channel_id=b"chan", data=b"\x01" * 100)
+    assert pw.decode_envelope(pw.encode_envelope(m)) == m
+    a = pw.AuthMessageWithChallengeSeed(
+        auth_message=pw.AuthMessage(data=b"handshake"),
+        encrypted_challenge_seed=b"\x02" * 48,
+    )
+    assert pw.decode_auth_with_seed(pw.encode_auth_with_seed(a)) == a
