@@ -1,0 +1,75 @@
+"""Configuration for the grapevine-tpu engine.
+
+The reference fixes its knobs as compile-time constants (record size,
+62-message mailbox cap, reference README.md:78-80,137-139) plus CLI flags
+(expiry period, reference README.md:90). Here everything lives in one
+dataclass; the device-engine geometry (tree heights, bucket slots, stash
+size, batch size) are the TPU analogs of "how much EPC the enclave maps".
+
+Capacity story: the records store is a Path-ORAM bucket tree with
+``2**records_height`` leaves and a dense block space of the same size; the
+mailbox store is a two-choice cuckoo table over its own Path-ORAM. Maximum
+in-flight messages = ``max_messages`` (bounded by the free-block list);
+maximum distinct recipients with mail = bounded by the cuckoo table load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from .wire import constants as C
+
+
+@dataclasses.dataclass(frozen=True)
+class GrapevineConfig:
+    # --- semantic capacities -------------------------------------------
+    #: max in-flight messages on the bus (reference README.md:75-76)
+    max_messages: int = 1 << 14
+    #: max distinct recipients with in-flight messages
+    max_recipients: int = 1 << 12
+    #: per-recipient in-flight cap (reference README.md:78-80)
+    mailbox_cap: int = C.MAILBOX_CAP
+    #: message expiry period in seconds; 0 disables (reference README.md:86-98)
+    expiry_period: int = 0
+
+    # --- device engine geometry ----------------------------------------
+    #: Path-ORAM bucket capacity (Z); upstream mc-oblivious uses Z=4 with
+    #: 4096B buckets of 1024B blocks (SURVEY.md §7.4)
+    bucket_slots: int = 4
+    #: fixed stash slots per ORAM (overflow is a sticky internal error)
+    stash_size: int = 96
+    #: client ops per jit'd access round; host pads with dummy ops
+    batch_size: int = 8
+    #: cuckoo slots per mailbox-table bucket (two-choice, no eviction chains)
+    cuckoo_slots: int = 2
+    #: mailbox cuckoo table load headroom: table buckets = ceil(
+    #: max_recipients / (cuckoo_slots * cuckoo_load))
+    cuckoo_load: float = 0.5
+
+    @property
+    def records_height(self) -> int:
+        """Tree height of the records ORAM: leaves = 2**height >= max_messages."""
+        return max(1, math.ceil(math.log2(self.max_messages)))
+
+    @property
+    def records_leaves(self) -> int:
+        return 1 << self.records_height
+
+    @property
+    def mailbox_table_buckets(self) -> int:
+        """Cuckoo table size (power of two) for the mailbox map."""
+        want = max(2, math.ceil(self.max_recipients / (self.cuckoo_slots * self.cuckoo_load)))
+        return 1 << max(1, math.ceil(math.log2(want)))
+
+    @property
+    def mailbox_height(self) -> int:
+        """Tree height of the mailbox ORAM: block space = cuckoo table buckets."""
+        return max(1, math.ceil(math.log2(self.mailbox_table_buckets)))
+
+    @property
+    def mailbox_leaves(self) -> int:
+        return 1 << self.mailbox_height
+
+
+DEFAULT_CONFIG = GrapevineConfig()

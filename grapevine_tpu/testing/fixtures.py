@@ -28,33 +28,29 @@ def run_with_several_seeds(func: Callable[[random.Random], None], n_seeds: int =
         func(random.Random(seed))
 
 
-def _rand_bytes(rng: random.Random, n: int) -> bytes:
-    return rng.getrandbits(8 * n).to_bytes(n, "little")
-
-
 def random_request_record(rng: random.Random) -> RequestRecord:
     return RequestRecord(
-        msg_id=_rand_bytes(rng, C.MSG_ID_SIZE),
-        recipient=_rand_bytes(rng, C.PUBKEY_SIZE),
-        payload=_rand_bytes(rng, C.PAYLOAD_SIZE),
+        msg_id=rng.randbytes(C.MSG_ID_SIZE),
+        recipient=rng.randbytes(C.PUBKEY_SIZE),
+        payload=rng.randbytes(C.PAYLOAD_SIZE),
     )
 
 
 def random_record(rng: random.Random) -> Record:
     return Record(
-        msg_id=_rand_bytes(rng, C.MSG_ID_SIZE),
-        sender=_rand_bytes(rng, C.PUBKEY_SIZE),
-        recipient=_rand_bytes(rng, C.PUBKEY_SIZE),
+        msg_id=rng.randbytes(C.MSG_ID_SIZE),
+        sender=rng.randbytes(C.PUBKEY_SIZE),
+        recipient=rng.randbytes(C.PUBKEY_SIZE),
         timestamp=rng.getrandbits(64) | 1,  # engine guarantees nonzero timestamps
-        payload=_rand_bytes(rng, C.PAYLOAD_SIZE),
+        payload=rng.randbytes(C.PAYLOAD_SIZE),
     )
 
 
 def random_query_request(rng: random.Random) -> QueryRequest:
     return QueryRequest(
         request_type=rng.randrange(4) + 1,
-        auth_identity=_rand_bytes(rng, C.PUBKEY_SIZE),
-        auth_signature=_rand_bytes(rng, C.SIGNATURE_SIZE),
+        auth_identity=rng.randbytes(C.PUBKEY_SIZE),
+        auth_signature=rng.randbytes(C.SIGNATURE_SIZE),
         record=random_request_record(rng),
     )
 

@@ -1,0 +1,210 @@
+"""Semantics-oracle tests: every documented CRUD behavior and status path.
+
+Each case maps to a clause of the reference spec (grapevine.proto:57-122,
+README.md:162-175); the device engine is later held equal to this model.
+"""
+
+import random
+
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.testing.reference import HardProtocolError, ReferenceEngine
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+
+def key(n: int) -> bytes:
+    return bytes([n]) + b"\x00" * 31
+
+
+def payload(n: int) -> bytes:
+    return bytes([n]) * C.PAYLOAD_SIZE
+
+
+def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, pl=None):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id, recipient=recipient, payload=pl or payload(0)
+        ),
+    )
+
+
+@pytest.fixture
+def eng():
+    return ReferenceEngine(
+        config=GrapevineConfig(max_messages=64, max_recipients=8, mailbox_cap=4),
+        rng=random.Random(42),
+    )
+
+
+def create(eng, sender, recipient, pl=None, now=NOW):
+    return eng.handle_query(req(C.REQUEST_TYPE_CREATE, sender, recipient=recipient, pl=pl), now)
+
+
+def test_create_assigns_random_nonzero_id_and_server_timestamp(eng):
+    r = req(C.REQUEST_TYPE_CREATE, key(1), msg_id=b"\x07" * 16, recipient=key(2))
+    resp = eng.handle_query(r, NOW)
+    assert resp.status_code == C.STATUS_CODE_SUCCESS
+    # client-supplied id ignored (grapevine.proto:66-68)
+    assert resp.record.msg_id != b"\x07" * 16
+    assert resp.record.msg_id != C.ZERO_MSG_ID
+    assert resp.record.timestamp == NOW
+    assert resp.record.sender == key(1)
+    assert resp.record.recipient == key(2)
+
+
+def test_create_zero_recipient_rejected(eng):
+    resp = create(eng, key(1), C.ZERO_PUBKEY)
+    assert resp.status_code == C.STATUS_CODE_INVALID_RECIPIENT
+    assert resp.record.msg_id == C.ZERO_MSG_ID  # zeroed record on failure
+    assert resp.record.timestamp != 0  # but real timestamp (constant size)
+
+
+def test_read_by_id_as_sender_and_recipient(eng):
+    mid = create(eng, key(1), key(2), pl=payload(9)).record.msg_id
+    for auth in (key(1), key(2)):
+        resp = eng.handle_query(req(C.REQUEST_TYPE_READ, auth, msg_id=mid), NOW)
+        assert resp.status_code == C.STATUS_CODE_SUCCESS
+        assert resp.record.payload == payload(9)
+    # a third party gets NOT_FOUND, identical to absence (grapevine.proto:83-86)
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(3), msg_id=mid), NOW)
+    assert resp.status_code == C.STATUS_CODE_NOT_FOUND
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(2), msg_id=b"\x55" * 16), NOW)
+    assert resp.status_code == C.STATUS_CODE_NOT_FOUND
+
+
+def test_read_zero_id_returns_oldest_message(eng):
+    m1 = create(eng, key(1), key(2), pl=payload(1)).record.msg_id
+    create(eng, key(3), key(2), pl=payload(2))
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(2)), NOW)
+    assert resp.status_code == C.STATUS_CODE_SUCCESS
+    assert resp.record.msg_id == m1  # oldest first
+    # sender identity has no mailbox: NOT_FOUND
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(1)), NOW)
+    assert resp.status_code == C.STATUS_CODE_NOT_FOUND
+
+
+def test_update_semantics(eng):
+    mid = create(eng, key(1), key(2), pl=payload(1)).record.msg_id
+    # zero id is a hard protocol error (grapevine.proto:95)
+    with pytest.raises(HardProtocolError):
+        eng.handle_query(req(C.REQUEST_TYPE_UPDATE, key(1)), NOW)
+    # wrong recipient -> INVALID_RECIPIENT (grapevine.proto:101-103)
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_UPDATE, key(1), msg_id=mid, recipient=key(9)), NOW
+    )
+    assert resp.status_code == C.STATUS_CODE_INVALID_RECIPIENT
+    # correct update refreshes payload + timestamp (grapevine.proto:92-94)
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_UPDATE, key(2), msg_id=mid, recipient=key(2), pl=payload(7)),
+        NOW + 5,
+    )
+    assert resp.status_code == C.STATUS_CODE_SUCCESS
+    assert resp.record.payload == payload(7)
+    assert resp.record.timestamp == NOW + 5
+    # unauthorized/absent -> NOT_FOUND
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_UPDATE, key(5), msg_id=mid, recipient=key(2)), NOW
+    )
+    assert resp.status_code == C.STATUS_CODE_NOT_FOUND
+
+
+def test_delete_by_id_requires_recipient_match_and_pops_mailbox(eng):
+    mid = create(eng, key(1), key(2)).record.msg_id
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_DELETE, key(1), msg_id=mid, recipient=key(9)), NOW
+    )
+    assert resp.status_code == C.STATUS_CODE_INVALID_RECIPIENT
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_DELETE, key(1), msg_id=mid, recipient=key(2)), NOW
+    )
+    assert resp.status_code == C.STATUS_CODE_SUCCESS
+    assert eng.message_count() == 0
+    # mailbox entry went with it (README.md:173-175)
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(2)), NOW)
+    assert resp.status_code == C.STATUS_CODE_NOT_FOUND
+
+
+def test_delete_zero_id_pops_in_order(eng):
+    m1 = create(eng, key(1), key(2)).record.msg_id
+    m2 = create(eng, key(1), key(2)).record.msg_id
+    r1 = eng.handle_query(req(C.REQUEST_TYPE_DELETE, key(2)), NOW)
+    r2 = eng.handle_query(req(C.REQUEST_TYPE_DELETE, key(2)), NOW)
+    assert [r1.record.msg_id, r2.record.msg_id] == [m1, m2]
+    r3 = eng.handle_query(req(C.REQUEST_TYPE_DELETE, key(2)), NOW)
+    assert r3.status_code == C.STATUS_CODE_NOT_FOUND
+
+
+def test_mailbox_cap(eng):
+    for _ in range(4):  # cap configured to 4 in fixture
+        assert create(eng, key(1), key(2)).status_code == C.STATUS_CODE_SUCCESS
+    resp = create(eng, key(1), key(2))
+    assert resp.status_code == C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT
+    # deleting one frees a slot
+    eng.handle_query(req(C.REQUEST_TYPE_DELETE, key(2)), NOW)
+    assert create(eng, key(1), key(2)).status_code == C.STATUS_CODE_SUCCESS
+
+
+def test_too_many_recipients():
+    eng = ReferenceEngine(
+        config=GrapevineConfig(max_messages=64, max_recipients=2, mailbox_cap=4),
+        rng=random.Random(1),
+    )
+    assert create(eng, key(1), key(2)).status_code == C.STATUS_CODE_SUCCESS
+    assert create(eng, key(1), key(3)).status_code == C.STATUS_CODE_SUCCESS
+    assert create(eng, key(1), key(4)).status_code == C.STATUS_CODE_TOO_MANY_RECIPIENTS
+    # existing recipient still fine
+    assert create(eng, key(1), key(3)).status_code == C.STATUS_CODE_SUCCESS
+
+
+def test_too_many_messages():
+    eng = ReferenceEngine(
+        config=GrapevineConfig(max_messages=3, max_recipients=8, mailbox_cap=62),
+        rng=random.Random(1),
+    )
+    for i in range(3):
+        assert create(eng, key(1), key(2 + i)).status_code == C.STATUS_CODE_SUCCESS
+    assert create(eng, key(1), key(7)).status_code == C.STATUS_CODE_TOO_MANY_MESSAGES
+
+
+def test_zero_auth_identity_is_hard_error(eng):
+    with pytest.raises(HardProtocolError):
+        eng.handle_query(req(C.REQUEST_TYPE_CREATE, C.ZERO_PUBKEY, recipient=key(2)), NOW)
+
+
+def test_expiry_sweep(eng):
+    create(eng, key(1), key(2), now=NOW)
+    mid_live = create(eng, key(1), key(2), now=NOW + 100).record.msg_id
+    assert eng.expire(NOW + 150, period=100) == 1
+    assert eng.message_count() == 1
+    resp = eng.handle_query(req(C.REQUEST_TYPE_READ, key(2)), NOW + 150)
+    assert resp.record.msg_id == mid_live
+    # update refreshes the expiry clock (grapevine.proto:93-94)
+    eng.handle_query(
+        req(C.REQUEST_TYPE_UPDATE, key(2), msg_id=mid_live, recipient=key(2)),
+        NOW + 200,
+    )
+    assert eng.expire(NOW + 290, period=100) == 0
+    assert eng.expire(NOW + 301, period=100) == 1
+    assert eng.message_count() == 0
+    assert eng.recipient_count() == 0
+
+
+def test_collision_status_with_forced_id(eng):
+    forced = b"\x11" * 16
+    assert (
+        eng.handle_query(
+            req(C.REQUEST_TYPE_CREATE, key(1), recipient=key(2)), NOW, forced_msg_id=forced
+        ).status_code
+        == C.STATUS_CODE_SUCCESS
+    )
+    resp = eng.handle_query(
+        req(C.REQUEST_TYPE_CREATE, key(1), recipient=key(3)), NOW, forced_msg_id=forced
+    )
+    assert resp.status_code == C.STATUS_CODE_MESSAGE_ID_ALREADY_IN_USE
