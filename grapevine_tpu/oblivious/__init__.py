@@ -1,0 +1,7 @@
+"""Data-oblivious vector primitives (the TPU analog of aligned-cmov).
+
+The reference's storage layer is built on constant-time conditional moves
+(upstream ``aligned-cmov``, SURVEY.md §2b). On TPU the same discipline is
+the *natural* programming model: all selection is `jnp.where` over full
+vectors, all control flow is masks, nothing branches on secret data.
+"""

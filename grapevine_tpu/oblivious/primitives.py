@@ -1,0 +1,80 @@
+"""Oblivious (branchless, constant-shape) building blocks.
+
+Every function here is a pure jnp program whose *instruction trace and
+memory addresses are independent of the data values* — the vectorized
+analog of the reference's constant-time cmov discipline (upstream
+``aligned-cmov``; SURVEY.md §2b). Secret-dependent decisions only ever
+appear as mask values flowing through `jnp.where`.
+
+Conventions:
+- multi-word values (keys, ids) are uint32 arrays with the word axis last;
+- masks are bool arrays;
+- "select one row" helpers use one-hot masked sums, never gathers at a
+  secret-dependent index (a gather's address would put the secret in the
+  access transcript).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+#: sentinel for "empty slot" in index arrays
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+def cmov(cond, a, b):
+    """Constant-shape conditional move: cond ? a : b (broadcasting where)."""
+    return jnp.where(cond, a, b)
+
+
+def words_equal(a, b):
+    """Rowwise equality of multi-word values: a[..., W] == b[..., W] → bool[...]."""
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero_words(a):
+    """True where a multi-word value is all-zero (invalid key / empty id)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def onehot_select(mask, values):
+    """Select the single row of ``values`` where ``mask`` is True.
+
+    mask: bool[N]; values: u32[N, ...] → u32[...]. If the mask has no (or
+    several) set lanes the result is the masked sum — callers guarantee
+    at-most-one match (an ORAM/table invariant) and handle the none-set
+    case via a separate ``found`` flag.
+    """
+    m = mask.astype(values.dtype)
+    m = m.reshape(m.shape + (1,) * (values.ndim - m.ndim))
+    return jnp.sum(values * m, axis=0)
+
+
+def first_true_onehot(mask):
+    """One-hot of the first True lane (all-False → all-False). bool[N]→bool[N]."""
+    idx = jnp.argmax(mask)  # 0 if none set; guarded below
+    onehot = jnp.arange(mask.shape[0]) == idx
+    return onehot & jnp.any(mask)
+
+
+def argmin_u64_onehot(valid, hi, lo):
+    """One-hot of the valid lane with the smallest (hi, lo) pair.
+
+    valid: bool[N]; hi, lo: u32[N] (a u64 split into words — jax runs with
+    x64 disabled, so the comparison is done lexicographically in u32).
+    Invalid lanes rank as +inf; ties break toward the lowest lane index.
+    Returns (onehot bool[N], any_valid bool).
+    """
+    inf = jnp.uint32(0xFFFFFFFF)
+    hi_m = jnp.where(valid, hi, inf)
+    min_hi = jnp.min(hi_m)
+    cand = valid & (hi_m == min_hi)
+    lo_m = jnp.where(cand, lo, inf)
+    min_lo = jnp.min(lo_m)
+    return first_true_onehot(cand & (lo_m == min_lo)), jnp.any(valid)
+
+
+def rank_of(mask):
+    """Exclusive prefix count of True lanes: rank[i] = #True among mask[:i]."""
+    return jnp.cumsum(mask.astype(jnp.int32)) - mask.astype(jnp.int32)

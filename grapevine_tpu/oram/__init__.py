@@ -1,0 +1,9 @@
+"""Path-ORAM over an HBM-resident SoA bucket tree (the storage heart).
+
+TPU-native re-design of the reference's storage engine (upstream
+``mc-oblivious-ram`` PathORAM-4096-Z4, SURVEY.md §2b): structure-of-arrays
+bucket tree, flat position map, fixed-size stash with masked linear scan,
+and greedy masked eviction — all as jit-compiled branchless array programs.
+"""
+
+from .path_oram import OramConfig, OramState, init_oram, oram_access  # noqa: F401

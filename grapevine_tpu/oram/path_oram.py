@@ -1,0 +1,284 @@
+"""Batched Path ORAM as a branchless JAX array program.
+
+Re-designs the reference's storage layer (upstream ``mc-oblivious-ram``'s
+PathORAM-4096-Z4 over ``aligned-cmov``; named at reference README.md:16,49
+and SURVEY.md §2b) for TPU:
+
+- the bucket tree is a structure-of-arrays resident in HBM: per-slot block
+  index, assigned leaf, and a ``value_words``-wide uint32 payload;
+- the position map is a flat uint32 array (recursion deferred; SURVEY.md
+  §7.4) living in *private* memory — see the threat model below;
+- the stash is a fixed-size array scanned with masked selects (the
+  vectorized constant-time linear scan);
+- eviction is the textbook greedy deepest-first assignment, computed as
+  masked prefix-sums + one conflict-free scatter per access.
+
+Threat model (the TPU translation of "inside the enclave" vs "untrusted
+host", SURVEY.md §1): the *public access transcript* is the sequence of
+bucket-tree paths (equivalently leaf indices) touched on the big HBM tree
+arrays. Obliviousness means this sequence is independent of which logical
+blocks are accessed and what operations are performed. The position map,
+stash, free lists, and scalar engine state are private working state (the
+EPC analog); upstream likewise keeps its top-level position map inside the
+enclave.
+
+Algorithm per access (Path ORAM, Stefanov et al., PAPERS.md):
+  1. ``leaf = posmap[idx]``; remap ``posmap[idx] = new_leaf`` (caller
+     supplies fresh uniform randomness — keeping the module deterministic
+     given its inputs, which is what makes transcript replay testable).
+  2. Fetch the ``height+1`` buckets on the root→leaf path into a working
+     set alongside the stash.
+  3. One masked scan finds the block; the caller's branchless ``fn``
+     computes the new value / keep / insert decision.
+  4. Greedy eviction reassigns every working-set entry to the deepest
+     bucket on the fetched path compatible with its leaf (common-prefix
+     depth), at most ``bucket_slots`` per bucket; leftovers return to the
+     stash. Stash overflow is counted in a sticky uint32 — it must never
+     fire at the configured geometry (tests assert this; Z=4 theory says
+     negligible).
+  5. Write the path back (same addresses — the write transcript equals the
+     read transcript).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..oblivious.primitives import SENTINEL, first_true_onehot, onehot_select, rank_of
+
+U32 = jnp.uint32
+
+
+@dataclasses.dataclass(frozen=True)
+class OramConfig:
+    """Static geometry (hashable: safe as a jit static argument)."""
+
+    height: int  # leaves = 2**height; block index space = [0, leaves)
+    value_words: int  # uint32 words per block value
+    bucket_slots: int = 4  # Z
+    stash_size: int = 96
+
+    @property
+    def leaves(self) -> int:
+        return 1 << self.height
+
+    @property
+    def n_buckets(self) -> int:
+        return (1 << (self.height + 1)) - 1
+
+    @property
+    def path_len(self) -> int:
+        return self.height + 1
+
+    @property
+    def work_size(self) -> int:
+        return self.stash_size + self.path_len * self.bucket_slots
+
+    #: reserved block index used by dummy accesses; never stored in the tree
+    @property
+    def dummy_index(self) -> int:
+        return self.leaves
+
+
+class OramState(NamedTuple):
+    """SoA ORAM state; a pytree (NamedTuple) so it jits/shards cleanly."""
+
+    tree_idx: jax.Array  # u32[n_buckets, Z]; SENTINEL = empty
+    tree_leaf: jax.Array  # u32[n_buckets, Z]
+    tree_val: jax.Array  # u32[n_buckets, Z, V]
+    stash_idx: jax.Array  # u32[S]
+    stash_leaf: jax.Array  # u32[S]
+    stash_val: jax.Array  # u32[S, V]
+    posmap: jax.Array  # u32[leaves + 1] (last entry backs the dummy index)
+    overflow: jax.Array  # u32 scalar, sticky count of dropped blocks
+
+
+def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
+    """Empty tree; position map initialized with uniform random leaves."""
+    z, v = cfg.bucket_slots, cfg.value_words
+    return OramState(
+        tree_idx=jnp.full((cfg.n_buckets, z), SENTINEL, U32),
+        tree_leaf=jnp.zeros((cfg.n_buckets, z), U32),
+        tree_val=jnp.zeros((cfg.n_buckets, z, v), U32),
+        stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
+        stash_leaf=jnp.zeros((cfg.stash_size,), U32),
+        stash_val=jnp.zeros((cfg.stash_size, v), U32),
+        posmap=jax.random.randint(
+            key, (cfg.leaves + 1,), 0, cfg.leaves, dtype=jnp.int32
+        ).astype(U32),
+        overflow=jnp.zeros((), U32),
+    )
+
+
+def path_bucket_indices(cfg: OramConfig, leaf: jax.Array) -> jax.Array:
+    """Heap indices of the root→leaf path buckets. leaf: u32 → u32[path_len]."""
+    depths = jnp.arange(cfg.path_len, dtype=U32)
+    return ((jnp.uint32(1) << depths) - 1) + (leaf >> (cfg.height - depths))
+
+
+def _common_prefix_depth(cfg: OramConfig, leaves_a: jax.Array, leaf_b: jax.Array):
+    """Deepest path level where a block with leaf ``leaves_a[i]`` may live on
+    the path to ``leaf_b``: the length of the common prefix of the two
+    height-bit leaf numbers. Exact integer computation, unrolled over the
+    (static) height."""
+    d = jnp.zeros(leaves_a.shape, jnp.int32)
+    for j in range(1, cfg.height + 1):
+        shift = cfg.height - j
+        d = d + (leaves_a >> shift == leaf_b >> shift).astype(jnp.int32)
+    return d  # in [0, height]
+
+
+def oram_access(
+    cfg: OramConfig,
+    state: OramState,
+    idx: jax.Array,  # u32 scalar block index (or cfg.dummy_index)
+    new_leaf: jax.Array,  # u32 scalar, fresh uniform in [0, leaves)
+    operand,
+    fn: Callable,
+):
+    """One oblivious read-modify-write access.
+
+    ``fn(value u32[V], present bool, operand) -> (new_value u32[V],
+    keep bool, insert bool, out pytree)``:
+
+    - if the block is present, its value becomes ``new_value``; ``keep``
+      False removes it (DELETE);
+    - if absent and ``insert``, ``(idx, new_value)`` is added (CREATE);
+    - ``out`` is returned to the caller (fetched fields, status bits).
+
+    ``fn`` must itself be branchless; it receives the *masked* value
+    (zeros when absent). Returns ``(state', out, leaf)`` where ``leaf`` is
+    the public transcript entry for this access.
+    """
+    z, v, plen = cfg.bucket_slots, cfg.value_words, cfg.path_len
+
+    leaf = state.posmap[idx]
+    posmap = state.posmap.at[idx].set(new_leaf)
+
+    path_b = path_bucket_indices(cfg, leaf)  # u32[plen]
+
+    # --- fetch path ∪ stash into the working set -----------------------
+    pidx = state.tree_idx[path_b].reshape(-1)  # u32[plen*z]
+    pleaf = state.tree_leaf[path_b].reshape(-1)
+    pval = state.tree_val[path_b].reshape(-1, v)
+    widx = jnp.concatenate([state.stash_idx, pidx])
+    wleaf = jnp.concatenate([state.stash_leaf, pleaf])
+    wval = jnp.concatenate([state.stash_val, pval], axis=0)
+
+    valid = widx != SENTINEL
+    match = valid & (widx == idx)
+    present = jnp.any(match)
+    value = onehot_select(match, wval)
+
+    new_value, keep, insert, out = fn(value, present, operand)
+
+    # --- apply the modification obliviously ----------------------------
+    wval = jnp.where(match[:, None], new_value[None, :], wval)
+    wleaf = jnp.where(match, new_leaf, wleaf)
+    drop = match & ~keep
+    widx = jnp.where(drop, SENTINEL, widx)
+
+    do_insert = insert & ~present & (idx != cfg.dummy_index)
+    free = widx == SENTINEL
+    ins_slot = first_true_onehot(free) & do_insert
+    inserted = jnp.any(ins_slot)
+    widx = jnp.where(ins_slot, idx, widx)
+    wleaf = jnp.where(ins_slot, new_leaf, wleaf)
+    wval = jnp.where(ins_slot[:, None], new_value[None, :], wval)
+    # a full working set on insert is an overflow (cannot happen at sane
+    # geometry: the path fetch alone frees plen*z slots)
+    insert_dropped = do_insert & ~inserted
+
+    # --- greedy deepest-first eviction ---------------------------------
+    valid = widx != SENTINEL
+    depth = _common_prefix_depth(cfg, wleaf, leaf)  # int32[W]
+    assign = jnp.full(valid.shape, -1, jnp.int32)  # path level, -1 = stash
+    pos = jnp.zeros(valid.shape, jnp.int32)  # slot within the bucket
+    placed = jnp.zeros(valid.shape, jnp.bool_)
+    for level in range(cfg.height, -1, -1):
+        eligible = valid & ~placed & (depth >= level)
+        r = rank_of(eligible)
+        chosen = eligible & (r < z)
+        assign = jnp.where(chosen, level, assign)
+        pos = jnp.where(chosen, r, pos)
+        placed = placed | chosen
+
+    # scatter placed entries into fresh path arrays (conflict-free: each
+    # (level, pos) pair is chosen at most once)
+    target = jnp.where(placed, assign * z + pos, plen * z)  # OOB = dropped
+    new_pidx = jnp.full((plen * z,), SENTINEL, U32).at[target].set(widx, mode="drop")
+    new_pleaf = jnp.zeros((plen * z,), U32).at[target].set(wleaf, mode="drop")
+    new_pval = jnp.zeros((plen * z, v), U32).at[target].set(wval, mode="drop")
+
+    # --- compact the leftovers back into the stash ---------------------
+    leftover = valid & ~placed
+    srank = rank_of(leftover)
+    starget = jnp.where(leftover, srank, cfg.stash_size)  # OOB = dropped
+    stash_idx = jnp.full((cfg.stash_size,), SENTINEL, U32).at[starget].set(
+        widx, mode="drop"
+    )
+    stash_leaf = jnp.zeros((cfg.stash_size,), U32).at[starget].set(wleaf, mode="drop")
+    stash_val = jnp.zeros((cfg.stash_size, v), U32).at[starget].set(wval, mode="drop")
+    stash_dropped = jnp.sum(leftover) - jnp.minimum(
+        jnp.sum(leftover), cfg.stash_size
+    )
+
+    overflow = (
+        state.overflow
+        + stash_dropped.astype(U32)
+        + insert_dropped.astype(U32)
+    )
+
+    # --- write the path back (write transcript ≡ read transcript) ------
+    new_state = OramState(
+        tree_idx=state.tree_idx.at[path_b].set(new_pidx.reshape(plen, z)),
+        tree_leaf=state.tree_leaf.at[path_b].set(new_pleaf.reshape(plen, z)),
+        tree_val=state.tree_val.at[path_b].set(new_pval.reshape(plen, z, v)),
+        stash_idx=stash_idx,
+        stash_leaf=stash_leaf,
+        stash_val=stash_val,
+        posmap=posmap,
+        overflow=overflow,
+    )
+    return new_state, out, leaf
+
+
+def oram_access_batch(
+    cfg: OramConfig,
+    state: OramState,
+    idxs: jax.Array,  # u32[B]
+    new_leaves: jax.Array,  # u32[B]
+    operands,  # pytree with leading batch axis
+    fn: Callable,
+):
+    """Sequentially-committed batch of accesses under one ``lax.scan``.
+
+    Within-batch ordering is "commit in slot order" — the semantics this
+    framework documents for batch hazards (two ops on one key in a round;
+    SURVEY.md §7.6). Each scan iteration is itself a wide vector program,
+    so the device pipelines the per-op work without host round-trips.
+
+    Returns ``(state', outs, leaves)`` with outs/leaves batched.
+    """
+
+    def step(carry, xs):
+        idx, new_leaf, opnd = xs
+        carry, out, leaf = oram_access(cfg, carry, idx, new_leaf, opnd, fn)
+        return carry, (out, leaf)
+
+    state, (outs, leaves) = jax.lax.scan(step, state, (idxs, new_leaves, operands))
+    return state, outs, leaves
+
+
+def stash_occupancy(state: OramState) -> jax.Array:
+    """Number of live stash entries (test/metrics helper)."""
+    return jnp.sum(state.stash_idx != SENTINEL)
+
+
+def tree_occupancy(state: OramState) -> jax.Array:
+    """Number of live blocks in the tree (test/metrics helper)."""
+    return jnp.sum(state.tree_idx != SENTINEL)

@@ -11,7 +11,10 @@ import time (pytest imports conftest first).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment may point JAX at the
+# tunneled TPU (JAX_PLATFORMS=axon), and running thousands of tiny test
+# dispatches over the tunnel is both slow and hardware-dependent.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

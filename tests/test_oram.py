@@ -1,0 +1,239 @@
+"""Path ORAM correctness: dict-model equivalence, transcript equality with
+the plain-Python mirror, determinism, and stash bounds.
+
+The test pyramid from SURVEY.md §4: (2) results equal a plain dict model;
+(3) public transcripts bit-identical to the scalar CPU reference.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grapevine_tpu.oram.path_oram import (
+    OramConfig,
+    init_oram,
+    oram_access,
+    oram_access_batch,
+    stash_occupancy,
+    tree_occupancy,
+)
+from grapevine_tpu.testing.ref_oram import RefPathOram
+
+CFG = OramConfig(height=5, value_words=4, bucket_slots=4, stash_size=48)
+
+
+def _fn(value, present, operand):
+    """Generic test op: mode 0=read, 1=write(insert), 2=delete."""
+    mode, wval = operand["mode"], operand["wval"]
+    is_write = mode == 1
+    is_delete = mode == 2
+    new_value = jnp.where(is_write, wval, value)
+    keep = ~is_delete
+    insert = is_write
+    out = {"value": value, "present": present}
+    return new_value, keep, insert, out
+
+
+def _ref_fn_factory(mode, wval):
+    def fn(value, present):
+        new_value = tuple(wval) if mode == 1 else value
+        keep = mode != 2
+        insert = mode == 1
+        return new_value, keep, insert, {"value": value, "present": present}
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def jit_access():
+    return jax.jit(oram_access, static_argnums=(0, 5))
+
+
+def random_ops(seed, n_ops, cfg):
+    """A random op sequence with a live-set model driving sensible ops."""
+    rng = random.Random(seed)
+    live = {}
+    ops = []
+    for _ in range(n_ops):
+        choices = ["insert"]
+        if live:
+            choices += ["read", "read", "delete", "update"]
+        if len(live) >= cfg.leaves - 1:
+            choices = ["read", "read", "delete", "update"]
+        c = rng.choice(choices)
+        if c == "insert":
+            free = [i for i in range(cfg.leaves) if i not in live]
+            idx = rng.choice(free)
+            val = tuple(rng.getrandbits(32) for _ in range(cfg.value_words))
+            live[idx] = val
+            ops.append((1, idx, val))
+        elif c == "update":
+            idx = rng.choice(list(live))
+            val = tuple(rng.getrandbits(32) for _ in range(cfg.value_words))
+            live[idx] = val
+            ops.append((1, idx, val))
+        elif c == "read":
+            # mix of live reads and misses
+            idx = rng.choice(list(live)) if rng.random() < 0.8 else rng.randrange(cfg.leaves)
+            ops.append((0, idx, (0,) * cfg.value_words))
+        else:
+            idx = rng.choice(list(live))
+            del live[idx]
+            ops.append((2, idx, (0,) * cfg.value_words))
+    return ops
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_oram_matches_dict_model_and_mirror_transcript(seed):
+    """One jitted scan over 300 random ops; bulk-compare every output with
+    the plain dict model and the scalar mirror (results AND transcript)."""
+    key = jax.random.PRNGKey(seed)
+    state = init_oram(CFG, key)
+    mirror = RefPathOram(CFG, np.asarray(state.posmap).tolist())
+
+    n_ops = 300
+    ops = random_ops(seed, n_ops, CFG)
+    leaf_rng = random.Random(1000 + seed)
+    new_leaves = [leaf_rng.randrange(CFG.leaves) for _ in range(n_ops)]
+
+    modes = np.array([m for m, _, _ in ops], np.uint32)
+    idxs = np.array([i for _, i, _ in ops], np.uint32)
+    wvals = np.array([v for _, _, v in ops], np.uint32)
+
+    batched = jax.jit(oram_access_batch, static_argnums=(0, 5))
+    state, outs, leaves = batched(
+        CFG,
+        state,
+        jnp.array(idxs),
+        jnp.array(new_leaves, dtype=jnp.uint32),
+        {"mode": jnp.array(modes), "wval": jnp.array(wvals)},
+        _fn,
+    )
+    leaves = np.asarray(leaves)
+    out_present = np.asarray(outs["present"])
+    out_values = np.asarray(outs["value"])
+    assert int(state.overflow) == 0
+
+    # replay through the scalar mirror and the dict model, compare everything
+    model = {}
+    for t, (mode, idx, val) in enumerate(ops):
+        ref_out, ref_leaf = mirror.access(
+            idx, new_leaves[t], _ref_fn_factory(mode, val)
+        )
+        assert leaves[t] == ref_leaf, f"transcript diverged at op {t}"
+        assert bool(out_present[t]) == ref_out["present"] == (idx in model)
+        if idx in model and mode == 0:
+            assert tuple(out_values[t]) == model[idx] == ref_out["value"]
+        if mode == 1:
+            model[idx] = val
+        elif mode == 2:
+            model.pop(idx, None)
+    assert mirror.overflow == 0
+
+    # end state: occupancy agrees everywhere
+    assert int(stash_occupancy(state)) + int(tree_occupancy(state)) == len(model)
+    assert int(stash_occupancy(state)) == mirror.stash_occupancy()
+
+
+def test_transcript_deterministic(jit_access):
+    """Same seed → same transcript; the engine's replayability guarantee."""
+
+    def run():
+        key = jax.random.PRNGKey(7)
+        state = init_oram(CFG, key)
+        leaves = []
+        leaf_rng = random.Random(7)
+        for i in range(50):
+            operand = {
+                "mode": jnp.uint32(1),
+                "wval": jnp.arange(CFG.value_words, dtype=jnp.uint32) + i,
+            }
+            state, _, leaf = jit_access(
+                CFG,
+                state,
+                jnp.uint32(i % CFG.leaves),
+                jnp.uint32(leaf_rng.randrange(CFG.leaves)),
+                operand,
+                _fn,
+            )
+            leaves.append(leaf)
+        return np.asarray(jnp.stack(leaves)).tolist()
+
+    assert run() == run()
+
+
+def test_batch_scan_matches_sequential(jit_access):
+    """oram_access_batch(scan) ≡ the same accesses issued one by one."""
+    key = jax.random.PRNGKey(3)
+    state_a = init_oram(CFG, key)
+    state_b = init_oram(CFG, key)
+
+    B = 32
+    rng = random.Random(5)
+    idxs = np.array([rng.randrange(CFG.leaves) for _ in range(B)], np.uint32)
+    leaves_in = np.array([rng.randrange(CFG.leaves) for _ in range(B)], np.uint32)
+    modes = np.array([1] * (B // 2) + [0] * (B // 2), np.uint32)
+    wvals = np.array(
+        [[rng.getrandbits(32) for _ in range(CFG.value_words)] for _ in range(B)],
+        np.uint32,
+    )
+    operands = {"mode": jnp.array(modes), "wval": jnp.array(wvals)}
+
+    batched = jax.jit(oram_access_batch, static_argnums=(0, 5))
+    state_a, outs, leaves_a = batched(
+        CFG, state_a, jnp.array(idxs), jnp.array(leaves_in), operands, _fn
+    )
+
+    seq_leaves = []
+    for i in range(B):
+        operand = {"mode": jnp.uint32(modes[i]), "wval": jnp.array(wvals[i])}
+        state_b, out, leaf = jit_access(
+            CFG, state_b, jnp.uint32(idxs[i]), jnp.uint32(leaves_in[i]), operand, _fn
+        )
+        seq_leaves.append(leaf)
+    seq_leaves = np.asarray(jnp.stack(seq_leaves)).tolist()
+
+    assert np.asarray(leaves_a).tolist() == seq_leaves
+    assert jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.all(a == b)), state_a, state_b)
+    )
+
+
+def test_stash_bounded_under_load():
+    """Fill to 75% occupancy, hammer with accesses: stash stays small."""
+    cfg = OramConfig(height=7, value_words=2, bucket_slots=4, stash_size=64)
+    key = jax.random.PRNGKey(11)
+    state = init_oram(cfg, key)
+    access = jax.jit(oram_access_batch, static_argnums=(0, 5))
+
+    n = (cfg.leaves * 3) // 4
+    rng = random.Random(13)
+    idxs = jnp.arange(n, dtype=jnp.uint32)
+    leaves_in = jnp.array([rng.randrange(cfg.leaves) for _ in range(n)], jnp.uint32)
+    operands = {
+        "mode": jnp.ones((n,), jnp.uint32),
+        "wval": jnp.ones((n, cfg.value_words), jnp.uint32),
+    }
+    state, _, _ = access(cfg, state, idxs, leaves_in, operands, _fn)
+    assert int(state.overflow) == 0
+
+    high_water = 0
+    for round_ in range(10):
+        perm = [rng.randrange(n) for _ in range(64)]
+        idxs = jnp.array(perm, jnp.uint32)
+        leaves_in = jnp.array(
+            [rng.randrange(cfg.leaves) for _ in range(64)], jnp.uint32
+        )
+        operands = {
+            "mode": jnp.zeros((64,), jnp.uint32),
+            "wval": jnp.zeros((64, cfg.value_words), jnp.uint32),
+        }
+        state, _, _ = access(cfg, state, idxs, leaves_in, operands, _fn)
+        high_water = max(high_water, int(stash_occupancy(state)))
+        assert int(state.overflow) == 0
+
+    # Z=4 Path ORAM stash stays far below the budget
+    assert high_water < cfg.stash_size // 2, high_water
