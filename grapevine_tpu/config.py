@@ -8,9 +8,11 @@ size, batch size) are the TPU analogs of "how much EPC the enclave maps".
 
 Capacity story: the records store is a Path-ORAM bucket tree with
 ``2**records_height`` leaves and a dense block space of the same size; the
-mailbox store is a two-choice cuckoo table over its own Path-ORAM. Maximum
-in-flight messages = ``max_messages`` (bounded by the free-block list);
-maximum distinct recipients with mail = bounded by the cuckoo table load.
+mailbox store is a single-choice keyed-hash table (K mailboxes per bucket)
+over its own Path-ORAM, run at low load so bucket overflow is negligible.
+Maximum in-flight messages = ``max_messages`` (bounded by the free-block
+list); maximum distinct recipients with mail = ``max_recipients`` (also
+soft-bounded by table load; overflow reports TOO_MANY_RECIPIENTS).
 """
 
 from __future__ import annotations
@@ -41,11 +43,13 @@ class GrapevineConfig:
     stash_size: int = 96
     #: client ops per jit'd access round; host pads with dummy ops
     batch_size: int = 8
-    #: cuckoo slots per mailbox-table bucket (two-choice, no eviction chains)
-    cuckoo_slots: int = 2
-    #: mailbox cuckoo table load headroom: table buckets = ceil(
-    #: max_recipients / (cuckoo_slots * cuckoo_load))
-    cuckoo_load: float = 0.5
+    #: mailboxes per hash bucket (one bucket = one mailbox-ORAM block)
+    mailbox_slots: int = 4
+    #: per-slot load target; table buckets = ceil(
+    #: max_recipients / (mailbox_slots * mailbox_load)). Low load keeps the
+    #: single-choice hash table's overflow probability negligible; a
+    #: relocating cuckoo scheme is a planned later optimization.
+    mailbox_load: float = 0.125
 
     @property
     def records_height(self) -> int:
@@ -58,13 +62,15 @@ class GrapevineConfig:
 
     @property
     def mailbox_table_buckets(self) -> int:
-        """Cuckoo table size (power of two) for the mailbox map."""
-        want = max(2, math.ceil(self.max_recipients / (self.cuckoo_slots * self.cuckoo_load)))
+        """Hash table size (power of two) for the mailbox map."""
+        want = max(
+            2, math.ceil(self.max_recipients / (self.mailbox_slots * self.mailbox_load))
+        )
         return 1 << max(1, math.ceil(math.log2(want)))
 
     @property
     def mailbox_height(self) -> int:
-        """Tree height of the mailbox ORAM: block space = cuckoo table buckets."""
+        """Tree height of the mailbox ORAM: block space = hash-table buckets."""
         return max(1, math.ceil(math.log2(self.mailbox_table_buckets)))
 
     @property

@@ -1,0 +1,26 @@
+"""The oblivious query engine: batched, branchless CRUD over two ORAMs.
+
+The TPU re-design of the reference's enclave query engine (the absent
+``enclave/trusted`` crate specified at reference grapevine.proto:57-122;
+SURVEY.md §1 layer 4-5). Architecture:
+
+- **records store**: Path ORAM with a dense block space; the server-assigned
+  msg_id *encodes* the block index (word 0) plus 96 random bits, so record
+  lookup is a single ORAM access with full-id verification in the stash —
+  no separate hash map and no id collisions (a deliberate deviation from
+  the reference's random-id + map design, grapevine.proto:66-79; ids remain
+  unguessable and the operator never sees them — they ride the encrypted
+  channel).
+- **mailbox store**: a keyed-hash table (recipient → bucket of K mailboxes)
+  over its own Path ORAM; each mailbox holds up to 62 entries
+  (reference README.md:78-80) of (msg_id, seq, ts).
+- **uniform access sequence**: every operation — Create, Read, Update,
+  Delete, and padding dummies — performs exactly [mailbox, records,
+  mailbox] ORAM accesses, so R/U/D are indistinguishable in the public
+  transcript as required (reference grapevine.proto:120-122); Create is
+  *allowed* to be distinguishable but is uniform here too.
+"""
+
+from .state import EngineConfig, EngineState, init_engine  # noqa: F401
+from .step import engine_step  # noqa: F401
+from .expiry import expiry_sweep  # noqa: F401

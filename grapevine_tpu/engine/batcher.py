@@ -1,0 +1,170 @@
+"""Host-side batching: wire records ↔ device SoA arrays, and the engine facade.
+
+The request batcher is the TPU analog of the reference's per-request
+enclave ECALL path (SURVEY.md §2c): N client operations are packed into
+one fixed-size jit'd access round; under-full batches are padded with
+dummy operations (request_type 0) that perform the identical ORAM access
+pattern, preserving the fixed cadence.
+
+Hard protocol errors (zero auth identity, UPDATE with zero id — the
+reference's fail-fast gRPC errors, grapevine.proto:60-64,95) are raised
+here on the host before anything reaches the device, exactly as the
+reference rejects them before the oblivious path.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from ..config import GrapevineConfig
+from ..testing.reference import HardProtocolError
+from ..wire import constants as C
+from ..wire.records import QueryRequest, QueryResponse, Record
+from .expiry import expiry_sweep
+from .state import EngineConfig, EngineState, init_engine
+from .step import engine_step
+
+
+def bytes_to_words(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype="<u4").copy()
+
+
+def words_to_bytes(w: np.ndarray) -> bytes:
+    return np.asarray(w, dtype="<u4").tobytes()
+
+
+def validate_request(req: QueryRequest) -> None:
+    """Fail-fast checks (reference grapevine.proto:57-64,95)."""
+    req.validate()
+    if req.auth_identity == C.ZERO_PUBKEY:
+        raise HardProtocolError("auth identity must be nonzero")
+    if not (1 <= req.request_type <= 4):
+        raise HardProtocolError(f"invalid request type {req.request_type}")
+    if req.request_type == C.REQUEST_TYPE_UPDATE and req.record.msg_id == C.ZERO_MSG_ID:
+        raise HardProtocolError("UPDATE with zero msg_id")
+
+
+def pack_batch(reqs: list[QueryRequest], batch_size: int, now: int) -> dict:
+    """Pack ≤batch_size validated requests into device arrays, dummy-padded."""
+    if len(reqs) > batch_size:
+        raise ValueError("too many requests for one batch")
+    b = batch_size
+    batch = {
+        "req_type": np.zeros((b,), np.uint32),
+        "auth": np.zeros((b, 8), np.uint32),
+        "msg_id": np.zeros((b, 4), np.uint32),
+        "recipient": np.zeros((b, 8), np.uint32),
+        "payload": np.zeros((b, 234), np.uint32),
+        "now": np.uint32(min(int(now), 0xFFFFFFFF)),
+    }
+    for i, req in enumerate(reqs):
+        batch["req_type"][i] = req.request_type
+        batch["auth"][i] = bytes_to_words(req.auth_identity)
+        batch["msg_id"][i] = bytes_to_words(req.record.msg_id)
+        batch["recipient"][i] = bytes_to_words(req.record.recipient)
+        batch["payload"][i] = bytes_to_words(req.record.payload)
+    return batch
+
+
+def unpack_responses(resp: dict, n: int) -> list[QueryResponse]:
+    status = np.asarray(resp["status"])
+    msg_id = np.asarray(resp["msg_id"])
+    sender = np.asarray(resp["sender"])
+    recipient = np.asarray(resp["recipient"])
+    ts = np.asarray(resp["timestamp"])
+    payload = np.asarray(resp["payload"])
+    out = []
+    for i in range(n):
+        out.append(
+            QueryResponse(
+                record=Record(
+                    msg_id=words_to_bytes(msg_id[i]),
+                    sender=words_to_bytes(sender[i]),
+                    recipient=words_to_bytes(recipient[i]),
+                    timestamp=int(ts[i]),
+                    payload=words_to_bytes(payload[i]),
+                ),
+                status_code=int(status[i]),
+            )
+        )
+    return out
+
+
+class GrapevineEngine:
+    """The in-process oblivious engine: the TPU analog of the enclave.
+
+    Thread-safe facade owning device state; the gRPC server calls
+    ``handle_queries`` with decrypted, authenticated requests and the
+    expiry timer calls ``expire``.
+    """
+
+    def __init__(self, config: GrapevineConfig | None = None, seed: int = 0):
+        self.config = config or GrapevineConfig()
+        self.ecfg = EngineConfig.from_config(self.config)
+        self.state: EngineState = init_engine(self.ecfg, seed)
+        self._step = jax.jit(engine_step, static_argnums=(0,))
+        self._sweep = jax.jit(expiry_sweep, static_argnums=(0,))
+        self._lock = threading.Lock()
+
+    def handle_queries(
+        self, reqs: list[QueryRequest], now: int
+    ) -> list[QueryResponse]:
+        """Process requests in slot order (padding to full batches)."""
+        for r in reqs:
+            validate_request(r)
+        if int(now) <= 0:
+            raise ValueError("server clock must be positive")
+        out: list[QueryResponse] = []
+        bs = self.ecfg.batch_size
+        with self._lock:
+            for i in range(0, len(reqs), bs):
+                chunk = reqs[i : i + bs]
+                batch = pack_batch(chunk, bs, now)
+                self.state, resp, _ = self._step(self.ecfg, self.state, batch)
+                out.extend(unpack_responses(resp, len(chunk)))
+        return out
+
+    def handle_queries_with_transcript(self, reqs, now):
+        """Test/bench variant returning the public transcript as well."""
+        for r in reqs:
+            validate_request(r)
+        bs = self.ecfg.batch_size
+        if len(reqs) > bs:
+            raise ValueError("single batch only")
+        with self._lock:
+            batch = pack_batch(reqs, bs, now)
+            self.state, resp, transcript = self._step(self.ecfg, self.state, batch)
+            return unpack_responses(resp, len(reqs)), np.asarray(transcript)
+
+    def expire(self, now: int, period: int | None = None) -> int:
+        """Run the expiry sweep; returns the number of records evicted."""
+        period = self.config.expiry_period if period is None else period
+        if period <= 0:
+            return 0
+        with self._lock:
+            before = int(self.state.free_top)
+            self.state = self._sweep(
+                self.ecfg,
+                self.state,
+                np.uint32(min(int(now), 0xFFFFFFFF)),
+                np.uint32(period),
+            )
+            return int(self.state.free_top) - before
+
+    # -- metrics (never keyed by client identity; SURVEY.md §5) ---------
+
+    def message_count(self) -> int:
+        return self.ecfg.max_messages - int(self.state.free_top)
+
+    def recipient_count(self) -> int:
+        return int(self.state.recipients)
+
+    def health(self) -> dict:
+        return {
+            "messages": self.message_count(),
+            "recipients": self.recipient_count(),
+            "stash_overflow": int(self.state.rec.overflow) + int(self.state.mb.overflow),
+        }

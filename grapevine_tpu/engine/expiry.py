@@ -1,0 +1,104 @@
+"""Expiry sweep: full-tree timestamped eviction (reference README.md:86-98).
+
+One jit'd data-independent pass over both ORAMs (the access pattern is
+the whole tree — revealing nothing): records older than the expiry period
+are invalidated, their mailbox entries cleared, emptied mailboxes release
+their recipient slot, and the free-block list is rebuilt. The reference
+MVP never finished hashmap eviction (README.md:98-99); this completes it.
+
+Timestamps come from the untrusted host clock, as in the reference
+(README.md:92-97); a tampered clock can evict early/late but the sweep
+touches every bucket regardless, so it cannot reveal sender/recipient
+linkage.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..oblivious.primitives import SENTINEL, is_zero_words
+from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS, mb_parse, mb_pack
+
+U32 = jnp.uint32
+
+
+def _expired(ts: jnp.ndarray, now, period) -> jnp.ndarray:
+    """Strict '>' age test, matching the oracle (now - ts > period)."""
+    return (now - ts) > period
+
+
+def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineState:
+    now = U32(now)
+    period = U32(period)
+
+    # --- records ORAM: invalidate expired blocks -----------------------
+    def sweep_records(idx, val):
+        live = idx != SENTINEL
+        dead = live & _expired(val[..., REC_TS], now, period)
+        return jnp.where(dead, SENTINEL, idx)
+
+    rec = state.rec
+    rec_tree_idx = sweep_records(rec.tree_idx, rec.tree_val)
+    rec_stash_idx = sweep_records(rec.stash_idx, rec.stash_val)
+    rec = rec._replace(tree_idx=rec_tree_idx, stash_idx=rec_stash_idx)
+
+    # --- mailbox ORAM: clear expired entries, drop empty mailboxes -----
+    def sweep_mb(idx, val):
+        # val: [..., V]; vectorize the parse over leading dims
+        lead = val.shape[:-1]
+        flat = val.reshape((-1, val.shape[-1]))
+        k, cap = ecfg.mb_slots, ecfg.mailbox_cap
+        keys = flat.reshape(-1, k, 8 + 4 * cap)[:, :, :8]
+        entries = flat.reshape(-1, k, 8 + 4 * cap)[:, :, 8:].reshape(-1, k, cap, 4)
+        valid = entries[..., ENT_SEQ] != 0
+        dead = valid & _expired(entries[..., ENT_TS], now, period)
+        entries = jnp.where(dead[..., None], jnp.zeros((4,), U32), entries)
+        mbox_live = jnp.any(entries[..., ENT_SEQ] != 0, axis=-1)  # [n, k]
+        keys = jnp.where(mbox_live[..., None], keys, jnp.zeros((8,), U32))
+        out = jnp.concatenate(
+            [keys, entries.reshape(-1, k, cap * 4)], axis=-1
+        ).reshape(flat.shape)
+        # blocks with no live mailbox leave the ORAM entirely
+        any_key = jnp.any(
+            ~is_zero_words(keys.reshape(-1, k, 8)).reshape(-1, k), axis=-1
+        ).reshape(lead)
+        new_idx = jnp.where(idx != SENTINEL, jnp.where(any_key, idx, SENTINEL), idx)
+        return new_idx, out.reshape(val.shape), keys.reshape(lead + (k, 8))
+
+    mb = state.mb
+    mb_tree_idx, mb_tree_val, tree_keys = sweep_mb(mb.tree_idx, mb.tree_val)
+    mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(mb.stash_idx, mb.stash_val)
+    mb = mb._replace(
+        tree_idx=mb_tree_idx,
+        tree_val=mb_tree_val,
+        stash_idx=mb_stash_idx,
+        stash_val=mb_stash_val,
+    )
+
+    # --- recount live recipients (keys survive only in live blocks) ----
+    def live_keys(keys, idx):
+        lead_live = idx != SENTINEL
+        kv = ~is_zero_words(keys)
+        return jnp.sum(kv & lead_live[..., None])
+
+    recipients = (
+        live_keys(tree_keys, mb_tree_idx) + live_keys(stash_keys, mb_stash_idx)
+    ).astype(U32)
+
+    # --- rebuild the free-block list from surviving record indices -----
+    n = ecfg.max_messages
+    present = jnp.zeros((n,), jnp.bool_)
+    for idx in (rec.tree_idx.reshape(-1), rec.stash_idx.reshape(-1)):
+        safe = jnp.where(idx != SENTINEL, idx, n)  # OOB drops
+        present = present.at[safe].set(True, mode="drop")
+    order = jnp.argsort(present, stable=True)  # free (False) indices first
+    freelist = order.astype(U32)
+    free_top = (n - jnp.sum(present)).astype(U32)
+
+    return state._replace(
+        rec=rec,
+        mb=mb,
+        freelist=freelist,
+        free_top=free_top,
+        recipients=recipients,
+    )

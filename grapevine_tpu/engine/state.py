@@ -1,0 +1,167 @@
+"""Engine state: the two ORAMs plus private scalar bookkeeping.
+
+Value layouts (all uint32 words, little-endian byte order on the host
+side; the device timestamp is a u32 of unix seconds — sufficient until
+2106, the wire format stays u64):
+
+records ORAM block (one Record, reference README.md:132-136):
+    id[4] | sender[8] | recipient[8] | ts[1] | payload[234]   = 255 words
+
+mailbox ORAM block (one hash bucket of K mailboxes):
+    per mailbox: key[8] | entries[cap × (blk[1] | idw[1] | seq[1] | ts[1])]
+    → K * (8 + 4*cap) words; with cap=62 a mailbox is exactly 256 words
+    (1 KiB), matching the record block budget.
+
+A mailbox entry stores only the record's block index plus the second
+msg-id word; the full 128-bit id lives in (and is verified against) the
+records ORAM. Truncated entry matching is only ever used to *locate* an
+entry after the records ORAM has verified the full id (phases B→C), or
+for zero-id selection where the mailbox invariant supplies correctness;
+block indices are unique among live records, so at most one entry can
+match.
+
+Private (non-transcript) state, the EPC analog — see the threat model in
+oram/path_oram.py: the free-block stack, live-recipient count, the global
+insertion sequence counter, the mailbox hash key, and the RNG key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import GrapevineConfig
+from ..oram.path_oram import OramConfig, OramState, init_oram
+
+U32 = jnp.uint32
+
+# records block layout offsets (words)
+REC_ID = slice(0, 4)
+REC_SENDER = slice(4, 12)
+REC_RECIPIENT = slice(12, 20)
+REC_TS = 20
+REC_PAYLOAD = slice(21, 255)
+REC_WORDS = 255
+
+PAYLOAD_WORDS = 234
+KEY_WORDS = 8
+ID_WORDS = 4
+ENTRY_WORDS = 4  # record block index | msg-id word 1 | seq | ts
+ENT_BLK = 0
+ENT_IDW = 1
+ENT_SEQ = 2
+ENT_TS = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static engine geometry derived from a GrapevineConfig."""
+
+    max_messages: int
+    max_recipients: int
+    mailbox_cap: int
+    expiry_period: int
+    batch_size: int
+    rec: OramConfig
+    mb: OramConfig
+    mb_table_buckets: int
+    mb_slots: int  # K mailboxes per hash bucket
+
+    @classmethod
+    def from_config(cls, cfg: GrapevineConfig) -> "EngineConfig":
+        m = cfg.mailbox_table_buckets
+        k = max(1, cfg.mailbox_slots)
+        mb_value_words = k * (KEY_WORDS + ENTRY_WORDS * cfg.mailbox_cap)
+        return cls(
+            max_messages=cfg.max_messages,
+            max_recipients=cfg.max_recipients,
+            mailbox_cap=cfg.mailbox_cap,
+            expiry_period=cfg.expiry_period,
+            batch_size=cfg.batch_size,
+            rec=OramConfig(
+                height=cfg.records_height,
+                value_words=REC_WORDS,
+                bucket_slots=cfg.bucket_slots,
+                stash_size=cfg.stash_size,
+            ),
+            mb=OramConfig(
+                height=cfg.mailbox_height,
+                value_words=mb_value_words,
+                bucket_slots=cfg.bucket_slots,
+                stash_size=cfg.stash_size,
+            ),
+            mb_table_buckets=m,
+            mb_slots=k,
+        )
+
+
+class EngineState(NamedTuple):
+    rec: OramState
+    mb: OramState
+    freelist: jax.Array  # u32[max_messages]; [0:free_top] = free block indices
+    free_top: jax.Array  # u32 scalar
+    recipients: jax.Array  # u32 scalar: live recipients
+    seq: jax.Array  # u32 scalar: global insertion counter
+    hash_key: jax.Array  # u32[2]: keyed mailbox-bucket PRF
+    rng: jax.Array  # jax PRNG key
+
+
+def init_engine(ecfg: EngineConfig, seed: int = 0) -> EngineState:
+    key = jax.random.PRNGKey(seed)
+    k_rec, k_mb, k_hash, k_rng = jax.random.split(key, 4)
+    return EngineState(
+        rec=init_oram(ecfg.rec, k_rec),
+        mb=init_oram(ecfg.mb, k_mb),
+        freelist=jnp.arange(ecfg.max_messages, dtype=U32),
+        free_top=jnp.uint32(ecfg.max_messages),
+        recipients=jnp.uint32(0),
+        seq=jnp.uint32(1),
+        hash_key=jax.random.bits(k_hash, (2,), U32),
+        rng=k_rng,
+    )
+
+
+def mb_parse(ecfg: EngineConfig, value: jax.Array):
+    """Split a mailbox block value into (keys [K,8], entries [K,cap,4])."""
+    k, cap = ecfg.mb_slots, ecfg.mailbox_cap
+    v = value.reshape(k, KEY_WORDS + ENTRY_WORDS * cap)
+    keys = v[:, :KEY_WORDS]
+    entries = v[:, KEY_WORDS:].reshape(k, cap, ENTRY_WORDS)
+    return keys, entries
+
+
+def mb_pack(ecfg: EngineConfig, keys: jax.Array, entries: jax.Array) -> jax.Array:
+    k, cap = ecfg.mb_slots, ecfg.mailbox_cap
+    flat = jnp.concatenate(
+        [keys, entries.reshape(k, cap * ENTRY_WORDS)], axis=1
+    )
+    return flat.reshape(k * (KEY_WORDS + ENTRY_WORDS * cap))
+
+
+def mb_bucket_hash(hash_key: jax.Array, recipient: jax.Array, n_buckets: int):
+    """Keyed PRF: recipient (8 words) → bucket index in [0, n_buckets).
+
+    A small ARX/multiply mixer (murmur-style finalizer per word). Secret
+    ``hash_key`` keeps bucket choices unpredictable to clients, thwarting
+    targeted hash-flooding of one bucket (the analog of the reference's
+    enclave-private hashing).
+    """
+    h = hash_key[0]
+    c1, c2 = jnp.uint32(0xCC9E2D51), jnp.uint32(0x1B873593)
+    for w in range(KEY_WORDS):
+        x = recipient[..., w] * c1
+        x = (x << 15) | (x >> 17)
+        x = x * c2
+        h = h ^ x
+        h = (h << 13) | (h >> 19)
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h = h ^ hash_key[1]
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h & jnp.uint32(n_buckets - 1)

@@ -1,0 +1,443 @@
+"""The batched query step: uniform [mailbox, records, mailbox] access rounds.
+
+Implements the complete CRUD semantics of the reference spec
+(grapevine.proto:57-122) as one branchless program per operation,
+sequentially committed over the batch under ``lax.scan`` (within-batch
+ordering = slot order; the reference never faced batch hazards — this
+framework documents slot-order commit, SURVEY.md §7.6).
+
+Why three phases, and what each decides (designed so that *every* op type
+touches the two ORAMs in the identical pattern, grapevine.proto:120-122):
+
+- **Phase A** (mailbox bucket of the operative recipient key):
+  CREATE runs all its capacity checks and appends the new entry (every
+  failure mode of CREATE is decidable here: zero recipient and bus-full
+  are known before any access, mailbox-cap and table-room are properties
+  of this bucket). Zero-id READ/DELETE select the oldest entry (min seq);
+  zero-id DELETE removes it immediately (the mailbox invariant guarantees
+  phase B succeeds). Other ops read and write back unchanged.
+- **Phase B** (records block): full id verification, auth check
+  (sender-or-recipient, reference grapevine.proto:83-86), recipient-match
+  check for UPDATE/DELETE (grapevine.proto:101-113), payload/timestamp
+  rewrite for UPDATE, removal for DELETE, insertion for CREATE.
+- **Phase C** (same mailbox bucket again): sender-authorized DELETE
+  removal (needed B's sender check), UPDATE's entry-timestamp refresh
+  (keeps mailbox expiry in sync with the record), dummies elsewhere.
+
+The msg_id returned by CREATE is [block_index, r1|1, r2, r3] — random and
+nonzero as required (grapevine.proto:66-79), with the block index embedded
+so lookup needs no id→block map; MESSAGE_ID_ALREADY_IN_USE is therefore
+structurally unreachable (the reference deems collisions "unlikely"; here
+they are impossible).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..oblivious.primitives import (
+    argmin_u64_onehot,
+    first_true_onehot,
+    is_zero_words,
+    onehot_select,
+    words_equal,
+)
+from ..wire import constants as C
+from ..oram.path_oram import oram_access
+from .state import (
+    ENT_BLK,
+    ENT_IDW,
+    ENT_SEQ,
+    ENT_TS,
+    EngineConfig,
+    EngineState,
+    REC_ID,
+    REC_PAYLOAD,
+    REC_RECIPIENT,
+    REC_SENDER,
+    REC_TS,
+    mb_bucket_hash,
+    mb_pack,
+    mb_parse,
+)
+
+U32 = jnp.uint32
+
+
+def _phase_a(ecfg: EngineConfig, value, present, o):
+    keys, entries = mb_parse(ecfg, value)
+    key_valid = ~is_zero_words(keys)
+    slot_match = key_valid & words_equal(keys, o["ka"][None, :])
+    found = jnp.any(slot_match)
+    free_slot_oh = first_true_onehot(~key_valid)
+    has_free_slot = jnp.any(~key_valid)
+    tgt_oh = jnp.where(found, slot_match, free_slot_oh)
+
+    tgt_entries = onehot_select(tgt_oh, entries)  # [cap, 4]
+    ent_valid = tgt_entries[:, ENT_SEQ] != 0
+    count = jnp.sum(ent_valid.astype(jnp.int32))
+
+    # --- CREATE decision tree (status precedence documented in
+    # testing/reference.py) -------------------------------------------
+    room_for_new_recipient = has_free_slot & (o["recipients"] < ecfg.max_recipients)
+    cap_ok = count < ecfg.mailbox_cap
+    create_ok = (
+        o["is_create"]
+        & ~o["zero_recip"]
+        & o["can_alloc"]
+        & (found | room_for_new_recipient)
+        & cap_ok
+    )
+    status_a = jnp.where(
+        o["zero_recip"],
+        C.STATUS_CODE_INVALID_RECIPIENT,
+        jnp.where(
+            ~o["can_alloc"],
+            C.STATUS_CODE_TOO_MANY_MESSAGES,
+            jnp.where(
+                ~found & ~room_for_new_recipient,
+                C.STATUS_CODE_TOO_MANY_RECIPIENTS,
+                jnp.where(
+                    ~cap_ok,
+                    C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT,
+                    C.STATUS_CODE_SUCCESS,
+                ),
+            ),
+        ),
+    ).astype(U32)
+
+    # --- zero-id selection: oldest entry (min seq) ---------------------
+    sel_oh, sel_found = argmin_u64_onehot(
+        ent_valid, jnp.zeros_like(tgt_entries[:, ENT_SEQ]), tgt_entries[:, ENT_SEQ]
+    )
+    sel_entry = onehot_select(sel_oh, tgt_entries)
+    sel_found = sel_found & found
+
+    # --- zero-id DELETE ("pop next") removal ---------------------------
+    # Only the zero-id case may act here: the selected entry's record is
+    # guaranteed live with recipient == the mailbox key (invariant), and
+    # the caller IS that key, so phase B's checks cannot fail. Explicit-id
+    # deletes always wait for phase B's full 128-bit id + auth verification
+    # and are finalized in phase C — acting early on a truncated id match
+    # would desync mailbox and records on a half-guessed id.
+    rm_a = o["is_delete"] & o["id_zero"] & sel_found
+    rm_oh = sel_oh
+
+    # --- apply append / removal to the target mailbox ------------------
+    append_oh = first_true_onehot(~ent_valid) & create_ok
+    new_entry = jnp.stack([o["alloc_idx"], o["new_id"][1], o["seq"], o["now"]])
+    ent_mod = jnp.where(append_oh[:, None], new_entry[None, :], tgt_entries)
+    ent_mod = jnp.where((rm_oh & rm_a)[:, None], jnp.zeros((4,), U32)[None, :], ent_mod)
+
+    count_after = count + create_ok.astype(jnp.int32) - rm_a.astype(jnp.int32)
+    clear_key = rm_a & (count_after == 0)
+    new_key = jnp.where(
+        create_ok & ~found,
+        o["ka"],
+        jnp.where(clear_key, jnp.zeros_like(o["ka"]), onehot_select(tgt_oh, keys)),
+    )
+
+    keys_out = jnp.where(tgt_oh[:, None], new_key[None, :], keys)
+    entries_out = jnp.where(tgt_oh[:, None, None], ent_mod[None, :, :], entries)
+
+    recip_delta = (create_ok & ~found).astype(jnp.int32) - clear_key.astype(jnp.int32)
+    keep = jnp.any(~is_zero_words(keys_out))
+    insert = create_ok & ~present
+
+    out = {
+        "found": found,
+        "sel_blk": sel_entry[ENT_BLK],
+        "sel_idw": sel_entry[ENT_IDW],
+        "sel_found": sel_found,
+        "create_ok": create_ok,
+        "status_a": status_a,
+        "rm_a": rm_a,
+        "recip_delta": recip_delta,
+    }
+    return mb_pack(ecfg, keys_out, entries_out), keep, insert, out
+
+
+def _phase_b(ecfg: EngineConfig, value, present, o):
+    stored_id = value[REC_ID]
+    sender = value[REC_SENDER]
+    recip_st = value[REC_RECIPIENT]
+    ts = value[REC_TS]
+
+    match2 = (stored_id[0] == o["sel_blk"]) & (stored_id[1] == o["sel_idw"])
+    match4 = words_equal(stored_id, o["msg_id"])
+    match_ok = present & jnp.where(o["id_zero"], match2, match4) & ~o["is_create"]
+
+    auth_ok = words_equal(o["auth"], sender) | words_equal(o["auth"], recip_st)
+    recip_match = words_equal(o["recipient"], recip_st)
+
+    read_ok = o["is_read"] & match_ok & auth_ok
+    upd_ok = o["is_update"] & match_ok & auth_ok & recip_match
+    del_ok = o["is_delete"] & match_ok & auth_ok & (o["id_zero"] | recip_match)
+
+    new_rec = jnp.concatenate(
+        [
+            o["new_id"],
+            o["auth"],
+            o["recipient"],
+            o["now"][None],
+            o["payload"],
+        ]
+    )
+    updated = value.at[REC_TS].set(o["now"]).at[REC_PAYLOAD].set(o["payload"])
+    new_value = jnp.where(
+        o["create_ok"], new_rec, jnp.where(upd_ok, updated, value)
+    )
+    keep = ~del_ok
+    insert = o["create_ok"]
+
+    out = {
+        "read_ok": read_ok,
+        "upd_ok": upd_ok,
+        "del_ok": del_ok,
+        "match_ok": match_ok,
+        "auth_ok": auth_ok,
+        "recip_match": recip_match,
+        "resp_id": stored_id,
+        "resp_sender": sender,
+        "resp_recipient": recip_st,
+        "resp_ts": jnp.where(upd_ok, o["now"], ts),
+        "resp_payload": jnp.where(upd_ok, o["payload"], value[REC_PAYLOAD]),
+    }
+    return new_value, keep, insert, out
+
+
+def _phase_c(ecfg: EngineConfig, value, present, o):
+    keys, entries = mb_parse(ecfg, value)
+    key_valid = ~is_zero_words(keys)
+    slot_match = key_valid & words_equal(keys, o["ka"][None, :])
+    found = jnp.any(slot_match)
+    tgt_entries = onehot_select(slot_match, entries)
+    ent_valid = tgt_entries[:, ENT_SEQ] != 0
+
+    ent_match = (
+        ent_valid
+        & (tgt_entries[:, ENT_BLK] == o["msg_id"][0])
+        & (tgt_entries[:, ENT_IDW] == o["msg_id"][1])
+    )
+
+    # sender-authorized delete finalization (B proved del_ok; A did not act)
+    rm_c = o["del_ok"] & ~o["rm_a"] & found
+    ent_mod = jnp.where(
+        (ent_match & rm_c)[:, None], jnp.zeros((4,), U32)[None, :], tgt_entries
+    )
+    # update refreshes the entry's expiry timestamp (record ts moved in B)
+    refresh = o["upd_ok"] & found
+    ent_mod = jnp.where(
+        (ent_match & refresh)[:, None],
+        ent_mod.at[:, ENT_TS].set(o["now"]),
+        ent_mod,
+    )
+
+    removed = jnp.any(ent_match & rm_c)
+    count_after = jnp.sum((ent_mod[:, ENT_SEQ] != 0).astype(jnp.int32))
+    clear_key = removed & (count_after == 0)
+    new_key = jnp.where(
+        clear_key, jnp.zeros_like(o["ka"]), onehot_select(slot_match, keys)
+    )
+
+    keys_out = jnp.where(slot_match[:, None], new_key[None, :], keys)
+    entries_out = jnp.where(slot_match[:, None, None], ent_mod[None, :, :], entries)
+
+    recip_delta = -clear_key.astype(jnp.int32)
+    keep = jnp.any(~is_zero_words(keys_out))
+    out = {"recip_delta": recip_delta}
+    return mb_pack(ecfg, keys_out, entries_out), keep, jnp.bool_(False), out
+
+
+def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
+    """Process one fixed-size batch of (already authenticated) requests.
+
+    ``batch``: req_type u32[B] (0 = padding dummy), auth u32[B,8],
+    msg_id u32[B,4], recipient u32[B,8], payload u32[B,234], now u32.
+
+    Returns ``(state', responses, transcript)``; responses carry status
+    u32[B] (0 for dummies) and full record fields; the transcript is the
+    public per-op leaf triple (mailbox, records, mailbox) — identical in
+    distribution for every op type.
+    """
+    B = batch["req_type"].shape[0]
+    now = batch["now"].astype(U32)
+
+    k_a, k_b, k_c, k_id, k_next = jax.random.split(state.rng, 5)
+    leaves_a = jax.random.bits(k_a, (B,), U32) & U32(ecfg.mb.leaves - 1)
+    leaves_b = jax.random.bits(k_b, (B,), U32) & U32(ecfg.rec.leaves - 1)
+    leaves_c = jax.random.bits(k_c, (B,), U32) & U32(ecfg.mb.leaves - 1)
+    id_rand = jax.random.bits(k_id, (B, 3), U32)
+
+    def step(carry: EngineState, xs):
+        rt, auth, msg_id, recipient, payload, nl_a, nl_b, nl_c, idr = xs
+
+        is_create = rt == C.REQUEST_TYPE_CREATE
+        is_read = rt == C.REQUEST_TYPE_READ
+        is_update = rt == C.REQUEST_TYPE_UPDATE
+        is_delete = rt == C.REQUEST_TYPE_DELETE
+        is_real = is_create | is_read | is_update | is_delete
+        id_zero = is_zero_words(msg_id)
+        zero_recip = is_zero_words(recipient)
+
+        can_alloc = carry.free_top > 0
+        alloc_pos = jnp.where(can_alloc, carry.free_top - 1, 0)
+        alloc_idx = carry.freelist[alloc_pos]
+        new_id = jnp.stack([alloc_idx, idr[0] | 1, idr[1], idr[2]])
+
+        # operative mailbox key: the recipient for create / explicit-id ops,
+        # the caller for zero-id next-message ops
+        ka = jnp.where(is_create | ~id_zero, recipient, auth)
+        bucket = mb_bucket_hash(carry.hash_key, ka, ecfg.mb_table_buckets)
+
+        o = {
+            "ka": ka,
+            "auth": auth,
+            "msg_id": msg_id,
+            "recipient": recipient,
+            "payload": payload,
+            "now": now,
+            "seq": carry.seq,
+            "recipients": carry.recipients,
+            "alloc_idx": alloc_idx,
+            "new_id": new_id,
+            "is_create": is_create & is_real,
+            "is_read": is_read,
+            "is_update": is_update,
+            "is_delete": is_delete,
+            "id_zero": id_zero,
+            "zero_recip": zero_recip,
+            "can_alloc": can_alloc,
+        }
+
+        # -- phase A: mailbox ------------------------------------------
+        mb1, out_a, leaf_a = oram_access(
+            ecfg.mb,
+            carry.mb,
+            jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index)),
+            nl_a,
+            o,
+            lambda v, p, oo: _phase_a(ecfg, v, p, oo),
+        )
+        o.update(out_a)
+
+        # -- phase B: records ------------------------------------------
+        lookup_blk = jnp.where(
+            out_a["create_ok"],
+            alloc_idx,
+            jnp.where(id_zero, out_a["sel_blk"], msg_id[0]),
+        )
+        real_b = is_real & (
+            out_a["create_ok"]
+            | (~is_create & (~id_zero | out_a["sel_found"]))
+        )
+        idx_b = jnp.where(
+            real_b, lookup_blk & U32(ecfg.rec.leaves - 1), U32(ecfg.rec.dummy_index)
+        )
+        rec1, out_b, leaf_b = oram_access(
+            ecfg.rec,
+            carry.rec,
+            idx_b,
+            nl_b,
+            o,
+            lambda v, p, oo: _phase_b(ecfg, v, p, oo),
+        )
+        o.update({"del_ok": out_b["del_ok"], "upd_ok": out_b["upd_ok"]})
+
+        # -- freelist bookkeeping (private memory) ---------------------
+        free_top1 = carry.free_top - out_a["create_ok"].astype(U32)
+        push_pos = jnp.where(out_b["del_ok"], free_top1, U32(ecfg.max_messages))
+        freelist = carry.freelist.at[push_pos].set(idx_b, mode="drop")
+        free_top2 = free_top1 + out_b["del_ok"].astype(U32)
+
+        # -- phase C: mailbox again ------------------------------------
+        mb2, out_c, leaf_c = oram_access(
+            ecfg.mb,
+            mb1,
+            jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index)),
+            nl_c,
+            o,
+            lambda v, p, oo: _phase_c(ecfg, v, p, oo),
+        )
+
+        recipients = (
+            carry.recipients.astype(jnp.int32)
+            + out_a["recip_delta"]
+            + out_c["recip_delta"]
+        ).astype(U32)
+        seq = carry.seq + out_a["create_ok"].astype(U32)
+
+        # -- response assembly -----------------------------------------
+        ok_rud = out_b["read_ok"] | out_b["upd_ok"] | out_b["del_ok"]
+        status = jnp.where(
+            ~is_real,
+            U32(0),
+            jnp.where(
+                is_create,
+                out_a["status_a"],
+                jnp.where(
+                    ok_rud,
+                    U32(C.STATUS_CODE_SUCCESS),
+                    jnp.where(
+                        (is_update | is_delete)
+                        & ~id_zero
+                        & out_b["match_ok"]
+                        & out_b["auth_ok"]
+                        & ~out_b["recip_match"],
+                        U32(C.STATUS_CODE_INVALID_RECIPIENT),
+                        U32(C.STATUS_CODE_NOT_FOUND),
+                    ),
+                ),
+            ),
+        )
+        created = is_create & out_a["create_ok"]
+        zid = jnp.zeros((4,), U32)
+        zkey = jnp.zeros((8,), U32)
+        zpl = jnp.zeros_like(payload)
+        resp = {
+            "status": status,
+            "msg_id": jnp.where(created, new_id, jnp.where(ok_rud, out_b["resp_id"], zid)),
+            "sender": jnp.where(
+                created, auth, jnp.where(ok_rud, out_b["resp_sender"], zkey)
+            ),
+            "recipient": jnp.where(
+                created, recipient, jnp.where(ok_rud, out_b["resp_recipient"], zkey)
+            ),
+            "timestamp": jnp.where(
+                created | ok_rud,
+                jnp.where(created, now, out_b["resp_ts"]),
+                jnp.where(is_real, now, U32(0)),
+            ),
+            "payload": jnp.where(
+                created, payload, jnp.where(ok_rud, out_b["resp_payload"], zpl)
+            ),
+        }
+        transcript = jnp.stack([leaf_a, leaf_b, leaf_c])
+
+        carry = EngineState(
+            rec=rec1,
+            mb=mb2,
+            freelist=freelist,
+            free_top=free_top2,
+            recipients=recipients,
+            seq=seq,
+            hash_key=carry.hash_key,
+            rng=carry.rng,
+        )
+        return carry, (resp, transcript)
+
+    xs = (
+        batch["req_type"].astype(U32),
+        batch["auth"],
+        batch["msg_id"],
+        batch["recipient"],
+        batch["payload"],
+        leaves_a,
+        leaves_b,
+        leaves_c,
+        id_rand,
+    )
+    state, (responses, transcripts) = jax.lax.scan(step, state, xs)
+    state = state._replace(rng=k_next)
+    return state, responses, transcripts

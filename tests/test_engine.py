@@ -1,0 +1,248 @@
+"""Device engine vs CPU oracle: result equality on random op sequences,
+R/U/D transcript indistinguishability, expiry, and capacity reuse.
+
+Test pyramid items (2), (4) from SURVEY.md §4.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.testing.reference import ReferenceEngine
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+SMALL = GrapevineConfig(
+    max_messages=64,
+    max_recipients=8,
+    mailbox_cap=4,
+    batch_size=8,
+    stash_size=64,
+)
+
+
+def key(n: int) -> bytes:
+    return bytes([n, n ^ 0x5A]) + b"\x01" * 30
+
+
+def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, pl=None, tag=0):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id,
+            recipient=recipient,
+            payload=pl if pl is not None else bytes([tag & 0xFF]) * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GrapevineEngine(SMALL, seed=3)
+
+
+def assert_responses_equal(dev, ora, ctx=""):
+    assert dev.status_code == ora.status_code, f"{ctx}: status {dev.status_code} != {ora.status_code}"
+    assert dev.record.msg_id == ora.record.msg_id, f"{ctx}: id"
+    assert dev.record.sender == ora.record.sender, f"{ctx}: sender"
+    assert dev.record.recipient == ora.record.recipient, f"{ctx}: recipient"
+    assert dev.record.payload == ora.record.payload, f"{ctx}: payload"
+    assert dev.record.timestamp == ora.record.timestamp, f"{ctx}: ts"
+
+
+def test_engine_matches_oracle_random_ops():
+    """~200 random CRUD ops, engine and oracle must agree on everything."""
+    engine = GrapevineEngine(SMALL, seed=1)
+    oracle = ReferenceEngine(config=SMALL, rng=random.Random(99))
+    rng = random.Random(42)
+    idents = [key(i + 1) for i in range(6)]
+    live_ids: list[tuple[bytes, bytes, bytes]] = []  # (msg_id, sender, recipient)
+
+    t = NOW
+    for step_no in range(40):
+        t += rng.randrange(3)
+        n_ops = rng.randrange(1, SMALL.batch_size + 1)
+        reqs = []
+        for _ in range(n_ops):
+            c = rng.random()
+            if c < 0.4 or not live_ids:
+                sender, recip = rng.choice(idents), rng.choice(idents)
+                reqs.append(req(C.REQUEST_TYPE_CREATE, sender, recipient=recip, tag=rng.randrange(256)))
+            elif c < 0.6:
+                mid, snd, rcp = rng.choice(live_ids)
+                auth = rng.choice([snd, rcp, rng.choice(idents)])
+                mid_q = mid if rng.random() < 0.8 else rng.randbytes(16)
+                reqs.append(req(C.REQUEST_TYPE_READ, auth, msg_id=mid_q))
+            elif c < 0.7:
+                auth = rng.choice(idents)
+                reqs.append(req(C.REQUEST_TYPE_READ, auth))  # zero id: next message
+            elif c < 0.8:
+                mid, snd, rcp = rng.choice(live_ids)
+                auth = rng.choice([snd, rcp])
+                recip_q = rcp if rng.random() < 0.8 else rng.choice(idents)
+                reqs.append(req(C.REQUEST_TYPE_UPDATE, auth, msg_id=mid, recipient=recip_q, tag=rng.randrange(256)))
+            elif c < 0.9:
+                mid, snd, rcp = rng.choice(live_ids)
+                auth = rng.choice([snd, rcp, rng.choice(idents)])
+                reqs.append(req(C.REQUEST_TYPE_DELETE, auth, msg_id=mid, recipient=rcp))
+            else:
+                auth = rng.choice(idents)
+                reqs.append(req(C.REQUEST_TYPE_DELETE, auth))  # pop next
+
+        dev_resps = engine.handle_queries(reqs, t)
+        for r, dev in zip(reqs, dev_resps):
+            forced = (
+                dev.record.msg_id
+                if r.request_type == C.REQUEST_TYPE_CREATE
+                and dev.status_code == C.STATUS_CODE_SUCCESS
+                else None
+            )
+            ora = oracle.handle_query(r, t, forced_msg_id=forced)
+            assert_responses_equal(dev, ora, f"step {step_no} op {r.request_type}")
+            # maintain the live-id pool from oracle state
+            if ora.status_code == C.STATUS_CODE_SUCCESS:
+                if r.request_type == C.REQUEST_TYPE_CREATE:
+                    live_ids.append(
+                        (ora.record.msg_id, ora.record.sender, ora.record.recipient)
+                    )
+                elif r.request_type == C.REQUEST_TYPE_DELETE:
+                    live_ids = [e for e in live_ids if e[0] != ora.record.msg_id]
+
+        assert engine.message_count() == oracle.message_count()
+        assert engine.recipient_count() == oracle.recipient_count()
+    assert engine.health()["stash_overflow"] == 0
+
+
+def test_mailbox_cap_and_capacity_reuse():
+    cfg = GrapevineConfig(
+        max_messages=8, max_recipients=4, mailbox_cap=3, batch_size=4, stash_size=64
+    )
+    engine = GrapevineEngine(cfg, seed=5)
+    a, b = key(1), key(2)
+    # fill b's mailbox to the cap
+    for i in range(3):
+        (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+    assert r.status_code == C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT
+    # pop one, slot frees up
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_DELETE, b)], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+
+    # fill the whole bus (8 messages): 3 live for b, then 3 to key(3) (its
+    # cap), then the per-recipient cap kicks in
+    fills = [
+        engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=key(3))], NOW)[
+            0
+        ].status_code
+        for _ in range(5)
+    ]
+    assert fills == [C.STATUS_CODE_SUCCESS] * 3 + [
+        C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT
+    ] * 2
+    # 6 live; two more to fresh recipients fill the bus
+    for peer in (key(4), key(5)):
+        (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=peer)], NOW)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+    # bus now full: 8 live messages
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=key(6))], NOW)
+    assert r.status_code == C.STATUS_CODE_TOO_MANY_MESSAGES
+    # deleting one frees a block for reuse
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_DELETE, b)], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=key(4))], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+
+
+def test_rud_transcripts_bit_identical():
+    """READ, UPDATE, DELETE of the same message from identically-seeded
+    engines produce bit-identical public transcripts — the reference's
+    core obliviousness invariant (grapevine.proto:120-122), checked at
+    its strongest: not just same distribution, the same bits."""
+    a, b = key(7), key(8)
+
+    def fresh():
+        e = GrapevineEngine(SMALL, seed=11)
+        (r,) = e.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+        return e, r.record.msg_id
+
+    transcripts = {}
+    for rt in (C.REQUEST_TYPE_READ, C.REQUEST_TYPE_UPDATE, C.REQUEST_TYPE_DELETE):
+        e, mid = fresh()
+        _, tr = e.handle_queries_with_transcript(
+            [req(rt, b, msg_id=mid, recipient=b)], NOW + 1
+        )
+        transcripts[rt] = tr
+    assert np.array_equal(transcripts[C.REQUEST_TYPE_READ], transcripts[C.REQUEST_TYPE_UPDATE])
+    assert np.array_equal(transcripts[C.REQUEST_TYPE_READ], transcripts[C.REQUEST_TYPE_DELETE])
+
+    # failed ops are indistinguishable from successful ones too
+    e, mid = fresh()
+    _, tr_wrong_auth = e.handle_queries_with_transcript(
+        [req(C.REQUEST_TYPE_DELETE, key(9), msg_id=mid, recipient=b)], NOW + 1
+    )
+    assert np.array_equal(transcripts[C.REQUEST_TYPE_DELETE], tr_wrong_auth)
+
+
+def test_delete_with_half_guessed_id_mutates_nothing():
+    """Regression: a DELETE whose msg_id matches on words 0-1 but not 2-3
+    must not touch the mailbox (the oracle mutates nothing on mismatch)."""
+    engine = GrapevineEngine(SMALL, seed=21)
+    a, b = key(1), key(2)
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    mid = r.record.msg_id
+    half = mid[:8] + bytes(x ^ 0xFF for x in mid[8:])  # words 0-1 right, 2-3 wrong
+    (d,) = engine.handle_queries(
+        [req(C.REQUEST_TYPE_DELETE, b, msg_id=half, recipient=b)], NOW + 1
+    )
+    assert d.status_code == C.STATUS_CODE_NOT_FOUND
+    # the message is still fully readable via the mailbox
+    (rr,) = engine.handle_queries([req(C.REQUEST_TYPE_READ, b)], NOW + 2)
+    assert rr.status_code == C.STATUS_CODE_SUCCESS
+    assert rr.record.msg_id == mid
+    assert engine.message_count() == 1
+
+
+def test_expiry_sweep_engine_vs_oracle():
+    cfg = GrapevineConfig(
+        max_messages=32, max_recipients=8, mailbox_cap=4, batch_size=4,
+        stash_size=64, expiry_period=100,
+    )
+    engine = GrapevineEngine(cfg, seed=6)
+    oracle = ReferenceEngine(config=cfg, rng=random.Random(1))
+    a, b, c = key(1), key(2), key(3)
+
+    for auth, recip, t in [(a, b, NOW), (a, c, NOW + 60), (c, b, NOW + 120)]:
+        (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, auth, recipient=recip)], t)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+        oracle.handle_query(
+            req(C.REQUEST_TYPE_CREATE, auth, recipient=recip), t,
+            forced_msg_id=r.record.msg_id,
+        )
+
+    n_dev = engine.expire(NOW + 151)
+    n_ora = oracle.expire(NOW + 151)
+    assert n_dev == n_ora == 1  # only the NOW message is older than 100
+    assert engine.message_count() == oracle.message_count() == 2
+    assert engine.recipient_count() == oracle.recipient_count()
+
+    # the expired message is gone from reads; survivors intact
+    for auth in (b, c):
+        dev = engine.handle_queries([req(C.REQUEST_TYPE_READ, auth)], NOW + 152)[0]
+        ora = oracle.handle_query(req(C.REQUEST_TYPE_READ, auth), NOW + 152)
+        assert_responses_equal(dev, ora, "post-expiry read")
+
+    # freed capacity is reusable
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW + 160)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
