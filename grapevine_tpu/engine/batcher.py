@@ -24,7 +24,14 @@ from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire.records import QueryRequest, QueryResponse, Record
 from .expiry import expiry_sweep
-from .state import EngineConfig, EngineState, init_engine
+from .state import (
+    EngineConfig,
+    EngineState,
+    ID_WORDS,
+    KEY_WORDS,
+    PAYLOAD_WORDS,
+    init_engine,
+)
 from .step import engine_step
 
 
@@ -54,10 +61,10 @@ def pack_batch(reqs: list[QueryRequest], batch_size: int, now: int) -> dict:
     b = batch_size
     batch = {
         "req_type": np.zeros((b,), np.uint32),
-        "auth": np.zeros((b, 8), np.uint32),
-        "msg_id": np.zeros((b, 4), np.uint32),
-        "recipient": np.zeros((b, 8), np.uint32),
-        "payload": np.zeros((b, 234), np.uint32),
+        "auth": np.zeros((b, KEY_WORDS), np.uint32),
+        "msg_id": np.zeros((b, ID_WORDS), np.uint32),
+        "recipient": np.zeros((b, KEY_WORDS), np.uint32),
+        "payload": np.zeros((b, PAYLOAD_WORDS), np.uint32),
         "now": np.uint32(min(int(now), 0xFFFFFFFF)),
     }
     for i, req in enumerate(reqs):

@@ -23,8 +23,13 @@ U32 = jnp.uint32
 
 
 def _expired(ts: jnp.ndarray, now, period) -> jnp.ndarray:
-    """Strict '>' age test, matching the oracle (now - ts > period)."""
-    return (now - ts) > period
+    """Strict '>' age test, matching the oracle (now - ts > period).
+
+    Guarded against u32 wraparound: a record stamped *ahead* of the sweep
+    clock (NTP step-back, caller-supplied smaller ``now``) must never be
+    treated as ancient — the oracle's signed comparison keeps it, so we
+    must too."""
+    return (ts <= now) & ((now - ts) > period)
 
 
 def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineState:

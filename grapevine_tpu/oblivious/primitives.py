@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import numpy as np
+
 U32 = jnp.uint32
-#: sentinel for "empty slot" in index arrays
-SENTINEL = jnp.uint32(0xFFFFFFFF)
+#: sentinel for "empty slot" in index arrays (a numpy scalar, not a device
+#: array: importing this package must not initialize a JAX backend)
+SENTINEL = np.uint32(0xFFFFFFFF)
 
 
 def cmov(cond, a, b):

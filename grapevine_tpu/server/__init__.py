@@ -1,0 +1,9 @@
+"""Host runtime: gRPC frontend, request scheduler, client library, CLI.
+
+The analog of the reference's ``grapevine-server`` binary + ``uri`` crate
+(reference README.md:122-128, uri/src/lib.rs; SURVEY.md §1 layers 1,6,7).
+"""
+
+from .uri import GrapevineUri  # noqa: F401
+from .service import GrapevineServer  # noqa: F401
+from .client import GrapevineClient  # noqa: F401

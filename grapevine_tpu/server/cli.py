@@ -1,0 +1,66 @@
+"""grapevine-tpu server CLI (the reference's ``./grapevine-server --help``,
+README.md:126, with the expiry period as a flag, README.md:90)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from ..config import GrapevineConfig
+from .service import GrapevineServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="grapevine-server",
+        description="TPU-native oblivious message bus server",
+    )
+    p.add_argument(
+        "--listen",
+        default="insecure-grapevine://0.0.0.0:3229",
+        help="listen URI: grapevine://host:port (TLS) or insecure-grapevine://host:port",
+    )
+    p.add_argument("--tls-cert", help="PEM certificate chain (required for grapevine://)")
+    p.add_argument("--tls-key", help="PEM private key (required for grapevine://)")
+    p.add_argument(
+        "--expiry-period",
+        type=int,
+        default=0,
+        help="seconds until messages expire; 0 disables the sweep",
+    )
+    p.add_argument("--msg-capacity", type=int, default=1 << 14, help="max in-flight messages")
+    p.add_argument(
+        "--recipient-capacity", type=int, default=1 << 12, help="max recipients with mail"
+    )
+    p.add_argument("--batch-size", type=int, default=8, help="ops per oblivious round")
+    p.add_argument(
+        "--batch-wait-ms", type=float, default=2.0, help="max wait to fill a round"
+    )
+    p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    config = GrapevineConfig(
+        max_messages=args.msg_capacity,
+        max_recipients=args.recipient_capacity,
+        expiry_period=args.expiry_period,
+        batch_size=args.batch_size,
+    )
+    server = GrapevineServer(config, seed=args.seed, max_wait_ms=args.batch_wait_ms)
+    tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
+    tls_key = open(args.tls_key, "rb").read() if args.tls_key else None
+    port = server.start(args.listen, tls_cert=tls_cert, tls_key=tls_key)
+    print(f"grapevine-tpu listening on port {port}", flush=True)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

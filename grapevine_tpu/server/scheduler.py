@@ -1,0 +1,77 @@
+"""Cross-connection request batcher.
+
+The north-star component the reference never needed (its enclave
+serialized per-op ECALLs; SURVEY.md §2c): concurrent gRPC handler threads
+submit single operations, and a collector thread packs them into
+fixed-size engine rounds — up to ``batch_size`` ops or ``max_wait_ms``,
+whichever first. Under-full rounds are dummy-padded by the engine, so the
+device cadence carries no information about load bursts beyond the round
+count itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from ..engine.batcher import GrapevineEngine
+from ..wire.records import QueryRequest, QueryResponse
+
+
+class BatchScheduler:
+    def __init__(
+        self,
+        engine: GrapevineEngine,
+        max_wait_ms: float = 2.0,
+        clock=None,
+    ):
+        self.engine = engine
+        self.max_wait = max_wait_ms / 1000.0
+        self.clock = clock or (lambda: int(time.time()))
+        self._queue: list[tuple[QueryRequest, Future]] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, req: QueryRequest) -> QueryResponse:
+        """Block until the op's round commits; returns its response."""
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("scheduler closed")
+            self._queue.append((req, fut))
+            self._cv.notify()
+        return fut.result()
+
+    def _run(self):
+        bs = self.engine.ecfg.batch_size
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                deadline = time.monotonic() + self.max_wait
+                while len(self._queue) < bs and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                chunk, self._queue = self._queue[:bs], self._queue[bs:]
+            reqs = [r for r, _ in chunk]
+            try:
+                resps = self.engine.handle_queries(reqs, self.clock())
+                for (_, fut), resp in zip(chunk, resps):
+                    fut.set_result(resp)
+            except Exception as exc:  # pragma: no cover - defensive
+                for _, fut in chunk:
+                    if not fut.done():
+                        fut.set_exception(exc)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5)

@@ -1,0 +1,214 @@
+"""gRPC frontend: the GrapevineAPI service (Auth, Query).
+
+Faithful to the reference service shape (grapevine.proto:10-15): ``Auth``
+performs the key exchange and returns the handshake reply plus the
+encrypted 32-byte challenge seed (AuthMessageWithChallengeSeed,
+grapevine.proto:26-36); ``Query`` carries only encrypted constant-size
+blobs. Implemented with grpc's generic handlers and the hand-rolled
+protowire codec — no protoc build step.
+
+Per-request auth (reference README.md:187-199): the server advances the
+session's challenge RNG on *every* Query before decrypting (lockstep,
+README.md:195-196), verifies the Schnorr signature over the challenge
+under context ``b"grapevine-challenge"``, and fails fast with
+INVALID_ARGUMENT on bad signatures or malformed requests (the reference's
+hard-error behavior, grapevine.proto:57-64).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from ..config import GrapevineConfig
+from ..engine.batcher import GrapevineEngine, validate_request
+from ..session import channel as chan
+from ..session import ristretto
+from ..session.chacha import ChallengeRng
+from ..testing.reference import HardProtocolError
+from ..wire import constants as C
+from ..wire import protowire as pw
+from ..wire.records import QueryRequest
+from .scheduler import BatchScheduler
+
+log = logging.getLogger("grapevine_tpu.server")
+
+SERVICE_NAME = "grapevine.GrapevineAPI"
+
+
+#: bytes appended to the challenge seed inside the Auth ciphertext: the
+#: server-assigned session token the client must present as channel_id.
+SESSION_TOKEN_SIZE = 16
+
+
+class _Session:
+    __slots__ = ("channel", "challenge_rng", "created", "last_used", "lock")
+
+    def __init__(self, secure_channel: chan.SecureChannel, seed: bytes):
+        self.channel = secure_channel
+        self.challenge_rng = ChallengeRng(seed)
+        self.created = time.time()
+        self.last_used = self.created
+        self.lock = threading.Lock()
+
+
+class GrapevineServer:
+    """The host server: session registry + engine + expiry timer."""
+
+    def __init__(
+        self,
+        config: GrapevineConfig | None = None,
+        seed: int = 0,
+        max_wait_ms: float = 2.0,
+        attestation=None,
+        clock=None,
+        session_ttl: float = 3600.0,
+        max_sessions: int = 4096,
+    ):
+        self.config = config or GrapevineConfig()
+        self.engine = GrapevineEngine(self.config, seed=seed)
+        self.scheduler = BatchScheduler(self.engine, max_wait_ms=max_wait_ms, clock=clock)
+        self.attestation = attestation or chan.NullAttestation()
+        self._sessions: dict[bytes, _Session] = {}
+        self._sessions_lock = threading.Lock()
+        self.session_ttl = session_ttl
+        self.max_sessions = max_sessions
+        self._grpc_server: grpc.Server | None = None
+        self._expiry_stop = threading.Event()
+        self._expiry_thread: threading.Thread | None = None
+        self.clock = clock or (lambda: int(time.time()))
+
+    # -- RPC handlers (raw-bytes serializers) ---------------------------
+
+    def _auth(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
+        try:
+            auth_msg = pw.decode_auth_message(request_bytes)
+            reply, secure_channel = chan.server_handshake(
+                auth_msg.data, self.attestation
+            )
+        except ValueError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"handshake: {exc}")
+        seed = chan.new_challenge_seed()
+        # the channel id is a server-assigned random token, delivered only
+        # inside the authenticated ciphertext: unguessable, unforgeable,
+        # and immune to session-clobbering via a replayed client pubkey
+        token = os.urandom(SESSION_TOKEN_SIZE)
+        encrypted_seed = secure_channel.encrypt(seed + token)
+        with self._sessions_lock:
+            self._evict_sessions_locked()
+            self._sessions[token] = _Session(secure_channel, seed)
+        return pw.encode_auth_with_seed(
+            pw.AuthMessageWithChallengeSeed(
+                auth_message=pw.AuthMessage(data=reply),
+                encrypted_challenge_seed=encrypted_seed,
+            )
+        )
+
+    def _evict_sessions_locked(self):
+        """Drop idle sessions past the TTL; at the cap, drop the oldest."""
+        now = time.time()
+        if self.session_ttl > 0:
+            dead = [k for k, s in self._sessions.items() if now - s.last_used > self.session_ttl]
+            for k in dead:
+                del self._sessions[k]
+        while len(self._sessions) >= self.max_sessions:
+            oldest = min(self._sessions, key=lambda k: self._sessions[k].last_used)
+            del self._sessions[oldest]
+
+    def _query(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
+        try:
+            envelope = pw.decode_envelope(request_bytes)
+        except ValueError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"malformed envelope: {exc}")
+        with self._sessions_lock:
+            session = self._sessions.get(envelope.channel_id)
+        if session is None:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "unknown channel")
+        session.last_used = time.time()
+        with session.lock:
+            # lockstep: draw the challenge before attempting decryption
+            challenge = session.challenge_rng.next_challenge()
+            try:
+                plaintext = session.channel.decrypt(envelope.data, aad=envelope.aad)
+            except Exception:
+                context.abort(grpc.StatusCode.UNAUTHENTICATED, "decryption failed")
+            try:
+                req = QueryRequest.unpack(plaintext)
+                validate_request(req)
+            except (ValueError, HardProtocolError) as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            if not ristretto.verify(
+                req.auth_identity,
+                C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
+                challenge,
+                req.auth_signature,
+            ):
+                context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad challenge signature")
+            resp = self.scheduler.submit(req)
+            ciphertext = session.channel.encrypt(resp.pack())
+        return pw.encode_envelope(pw.EnvelopeMessage(data=ciphertext))
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        identity = lambda b: b  # noqa: E731 — raw bytes on the wire
+        method_handlers = {
+            "Auth": grpc.unary_unary_rpc_method_handler(
+                self._auth, request_deserializer=identity, response_serializer=identity
+            ),
+            "Query": grpc.unary_unary_rpc_method_handler(
+                self._query, request_deserializer=identity, response_serializer=identity
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
+
+    def start(self, listen_uri, tls_cert: bytes | None = None, tls_key: bytes | None = None) -> int:
+        """Start serving; returns the bound port."""
+        from .uri import GrapevineUri
+
+        uri = (
+            listen_uri
+            if isinstance(listen_uri, GrapevineUri)
+            else GrapevineUri.parse(listen_uri)
+        )
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max(8, 2 * self.config.batch_size))
+        )
+        self._grpc_server.add_generic_rpc_handlers((self._handlers(),))
+        if uri.use_tls:
+            if not (tls_cert and tls_key):
+                raise ValueError("grapevine:// (TLS) requires tls_cert and tls_key")
+            creds = grpc.ssl_server_credentials([(tls_key, tls_cert)])
+            port = self._grpc_server.add_secure_port(uri.address, creds)
+        else:
+            port = self._grpc_server.add_insecure_port(uri.address)
+        if port == 0:
+            raise RuntimeError(f"failed to bind {uri.address}")
+        self._grpc_server.start()
+        if self.config.expiry_period > 0:
+            self._expiry_thread = threading.Thread(target=self._expiry_loop, daemon=True)
+            self._expiry_thread.start()
+        log.info("grapevine-tpu serving on %s", uri)
+        return port
+
+    def _expiry_loop(self):
+        interval = max(1.0, self.config.expiry_period / 10)
+        while not self._expiry_stop.wait(interval):
+            evicted = self.engine.expire(self.clock())
+            if evicted:
+                log.info("expiry sweep evicted %d records", evicted)
+
+    def stop(self, grace: float = 1.0):
+        self._expiry_stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace).wait()
+        self.scheduler.close()
+
+    def wait(self):
+        if self._grpc_server is not None:
+            self._grpc_server.wait_for_termination()

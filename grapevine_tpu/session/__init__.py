@@ -1,0 +1,34 @@
+"""Session layer: encrypted channel, challenge RNG, request signatures.
+
+Host-side re-design of the reference's attestation/session stack
+(``mc-attest-ake`` / ``mc-crypto-noise`` / ``mc-crypto-keys``; reference
+grapevine.proto:17-36 and README.md:177-199, SURVEY.md §2b):
+
+- :mod:`chacha`     — ChaCha20 keystream; the per-request challenge RNG
+  that client and server advance in lockstep (README.md:195-196).
+- :mod:`ristretto`  — ristretto255 group (pure Python) and Schnorr
+  signatures with the ``b"grapevine-challenge"`` signing context
+  (reference types/src/lib.rs:13).
+- :mod:`channel`    — X25519 + ChaCha20-Poly1305 encrypted channel with a
+  pluggable attestation-evidence interface. TPU has no enclave; the
+  evidence hook keeps SGX/TDX/none swappable (SURVEY.md §1 layer 2).
+
+Nothing in this package touches the device: channel crypto terminates on
+the host, exactly as the reference's session layer terminates at the
+enclave boundary.
+"""
+
+from .chacha import ChaCha20, ChallengeRng  # noqa: F401
+from .ristretto import (  # noqa: F401
+    RistrettoPoint,
+    keygen,
+    public_key,
+    sign,
+    verify,
+)
+from .channel import (  # noqa: F401
+    NullAttestation,
+    SecureChannel,
+    client_handshake,
+    server_handshake,
+)

@@ -1,0 +1,121 @@
+"""Encrypted session channel: X25519 handshake + ChaCha20-Poly1305 frames.
+
+The analog of the reference's attested noise channel (``mc-attest-ake``'s
+IX handshake + ``mc-crypto-noise`` cipher states; reference
+grapevine.proto:10-15, README.md:177-183). The handshake is
+ephemeral-ephemeral X25519 with HKDF-SHA256 key derivation and directional
+ChaCha20-Poly1305 cipher states with counter nonces.
+
+Attestation is a pluggable evidence interface: TPU offers no SGX-style
+remote attestation, so :class:`NullAttestation` ships empty evidence and
+accepts peers — the interface point is kept so SGX/TDX/vTPM evidence can
+slot in without touching the protocol (SURVEY.md §1 layer-2 mapping).
+
+Auth RPC wire shape (mirrors AuthMessageWithChallengeSeed,
+grapevine.proto:26-36): the server's handshake reply carries its ephemeral
+public key + evidence, and the 32-byte challenge seed travels only as
+ciphertext under the freshly established channel.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+_HKDF_INFO = b"grapevine-tpu-channel-v1"
+
+
+class NullAttestation:
+    """No-enclave evidence provider: empty evidence, accepts all peers."""
+
+    def evidence(self) -> bytes:
+        return b""
+
+    def verify(self, evidence: bytes) -> bool:
+        return True
+
+
+class SecureChannel:
+    """Directional AEAD cipher states with 96-bit counter nonces."""
+
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_n = 0
+        self._recv_n = 0
+
+    @staticmethod
+    def _nonce(counter: int) -> bytes:
+        return struct.pack("<Q", counter) + b"\x00" * 4
+
+    def encrypt(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        ct = self._send.encrypt(self._nonce(self._send_n), plaintext, aad)
+        self._send_n += 1
+        return ct
+
+    def decrypt(self, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        pt = self._recv.decrypt(self._nonce(self._recv_n), ciphertext, aad)
+        self._recv_n += 1
+        return pt
+
+
+def _derive(shared: bytes, transcript: bytes) -> tuple[bytes, bytes]:
+    okm = HKDF(
+        algorithm=hashes.SHA256(), length=64, salt=transcript, info=_HKDF_INFO
+    ).derive(shared)
+    return okm[:32], okm[32:]
+
+
+def client_handshake():
+    """Start a handshake: returns (state, first_message_bytes)."""
+    priv = X25519PrivateKey.generate()
+    pub = priv.public_key().public_bytes_raw()
+    return priv, pub
+
+
+def client_finish(priv: X25519PrivateKey, server_msg: bytes, attestation=None):
+    """Complete the handshake from the server's reply.
+
+    ``server_msg`` = server ephemeral pub (32) ‖ evidence. Returns a
+    :class:`SecureChannel` (client perspective).
+    """
+    attestation = attestation or NullAttestation()
+    if len(server_msg) < 32:
+        raise ValueError("short handshake reply")
+    server_pub, evidence = server_msg[:32], server_msg[32:]
+    if not attestation.verify(evidence):
+        raise ValueError("attestation evidence rejected")
+    shared = priv.exchange(X25519PublicKey.from_public_bytes(server_pub))
+    transcript = priv.public_key().public_bytes_raw() + server_pub
+    k_c2s, k_s2c = _derive(shared, transcript)
+    return SecureChannel(send_key=k_c2s, recv_key=k_s2c)
+
+
+def server_handshake(client_msg: bytes, attestation=None):
+    """Server side: returns (reply_bytes, channel).
+
+    ``client_msg`` = client ephemeral pub (32). The reply embeds this
+    side's ephemeral pub + attestation evidence.
+    """
+    attestation = attestation or NullAttestation()
+    if len(client_msg) != 32:
+        raise ValueError("handshake message must be a 32-byte public key")
+    priv = X25519PrivateKey.generate()
+    pub = priv.public_key().public_bytes_raw()
+    shared = priv.exchange(X25519PublicKey.from_public_bytes(client_msg))
+    transcript = client_msg + pub
+    k_c2s, k_s2c = _derive(shared, transcript)
+    channel = SecureChannel(send_key=k_s2c, recv_key=k_c2s)
+    return pub + attestation.evidence(), channel
+
+
+def new_challenge_seed() -> bytes:
+    return os.urandom(32)

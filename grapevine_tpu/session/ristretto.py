@@ -1,0 +1,220 @@
+"""ristretto255 group and Schnorr request signatures (pure Python).
+
+The reference authenticates every request with a deterministic Schnorrkel
+(sr25519) signature over the 32-byte challenge, under the signing context
+``b"grapevine-challenge"`` (reference README.md:193-199,
+types/src/lib.rs:13,44-52). This module provides the same *shape* of
+scheme on the same group: 32-byte ristretto255 public keys, 64-byte
+(R ‖ s) Schnorr signatures, deterministic nonces, context-separated
+hashing — implemented against RFC 9496 (ristretto255) with SHA-512 as the
+hash. It is deliberately **not** byte-compatible with schnorrkel (which
+uses merlin/STROBE transcripts); the signature scheme is a session-layer
+choice and the wire sizes are identical.
+
+Host-side only and not constant-time (Python ints): the server only
+*verifies* public signatures; client signing keys never touch the
+service. A constant-time native implementation is a later hardening item.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+_NONCE_DOMAIN = b"grapevine-tpu-schnorr-nonce"
+_CHAL_DOMAIN = b"grapevine-tpu-schnorr-chal"
+
+
+def _inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+def _is_neg(x: int) -> bool:
+    return (x & 1) == 1
+
+
+def _abs(x: int) -> int:
+    return (-x) % P if _is_neg(x) else x
+
+
+def _sqrt_ratio_m1(u: int, v: int) -> tuple[bool, int]:
+    """RFC 9496 SQRT_RATIO_M1: (was_square, sqrt(u/v) or sqrt(i·u/v))."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    u_neg = (-u) % P
+    correct = check == u % P
+    flipped = check == u_neg
+    flipped_i = check == u_neg * SQRT_M1 % P
+    if flipped or flipped_i:
+        r = r * SQRT_M1 % P
+    return (correct or flipped), _abs(r)
+
+
+INVSQRT_A_MINUS_D = _sqrt_ratio_m1(1, (-1 - D) % P)[1]
+
+
+class RistrettoPoint:
+    """Extended Edwards coordinates on edwards25519 (a = -1)."""
+
+    __slots__ = ("x", "y", "z", "t")
+
+    def __init__(self, x: int, y: int, z: int, t: int):
+        self.x, self.y, self.z, self.t = x % P, y % P, z % P, t % P
+
+    # -- group ops ------------------------------------------------------
+
+    def __add__(self, other: "RistrettoPoint") -> "RistrettoPoint":
+        a = (self.y - self.x) * (other.y - other.x) % P
+        b = (self.y + self.x) * (other.y + other.x) % P
+        c = self.t * (2 * D) % P * other.t % P
+        d = self.z * 2 % P * other.z % P
+        e, f, g, h = (b - a) % P, (d - c) % P, (d + c) % P, (b + a) % P
+        return RistrettoPoint(e * f, g * h, f * g, e * h)
+
+    def __neg__(self) -> "RistrettoPoint":
+        return RistrettoPoint((-self.x) % P, self.y, self.z, (-self.t) % P)
+
+    def __mul__(self, k: int) -> "RistrettoPoint":
+        k %= L
+        acc = IDENTITY
+        add = self
+        while k:
+            if k & 1:
+                acc = acc + add
+            add = add + add
+            k >>= 1
+        return acc
+
+    __rmul__ = __mul__
+
+    def __eq__(self, other) -> bool:
+        # ristretto equality over the coset (RFC 9496 §4.5):
+        # X1·Y2 == Y1·X2  OR  Y1·Y2 == X1·X2 (curve parameter a = -1)
+        if not isinstance(other, RistrettoPoint):
+            return NotImplemented
+        return (
+            self.x * other.y % P == self.y * other.x % P
+            or self.y * other.y % P == self.x * other.x % P
+        )
+
+    def __hash__(self):
+        return hash(self.encode())
+
+    # -- RFC 9496 encode / decode --------------------------------------
+
+    def encode(self) -> bytes:
+        x0, y0, z0, t0 = self.x, self.y, self.z, self.t
+        u1 = (z0 + y0) * (z0 - y0) % P
+        u2 = x0 * y0 % P
+        _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+        den1 = invsqrt * u1 % P
+        den2 = invsqrt * u2 % P
+        z_inv = den1 * den2 % P * t0 % P
+        ix0 = x0 * SQRT_M1 % P
+        iy0 = y0 * SQRT_M1 % P
+        enchanted = den1 * INVSQRT_A_MINUS_D % P
+        rotate = _is_neg(t0 * z_inv % P)
+        if rotate:
+            x, y, den_inv = iy0, ix0, enchanted
+        else:
+            x, y, den_inv = x0, y0, den2
+        if _is_neg(x * z_inv % P):
+            y = (-y) % P
+        s = _abs(den_inv * ((z0 - y) % P) % P)
+        return s.to_bytes(32, "little")
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RistrettoPoint":
+        if len(data) != 32:
+            raise ValueError("ristretto encoding must be 32 bytes")
+        s = int.from_bytes(data, "little")
+        if s >= P or _is_neg(s):
+            raise ValueError("non-canonical ristretto encoding")
+        ss = s * s % P
+        u1 = (1 - ss) % P
+        u2 = (1 + ss) % P
+        u2_sqr = u2 * u2 % P
+        v = (-(D * u1 % P * u1 % P) - u2_sqr) % P
+        was_square, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+        den_x = invsqrt * u2 % P
+        den_y = invsqrt * den_x % P * v % P
+        x = _abs(2 * s % P * den_x % P)
+        y = u1 * den_y % P
+        t = x * y % P
+        if not was_square or _is_neg(t) or y == 0:
+            raise ValueError("invalid ristretto encoding")
+        return cls(x, y, 1, t)
+
+
+IDENTITY = RistrettoPoint(0, 1, 1, 0)
+BASEPOINT = RistrettoPoint(
+    15112221349535400772501151409588531511454012693041857206046113283949847762202,
+    46316835694926478169428394003475163141307993866256225615783033603165251855960,
+    1,
+    15112221349535400772501151409588531511454012693041857206046113283949847762202
+    * 46316835694926478169428394003475163141307993866256225615783033603165251855960
+    % P,
+)
+
+
+# -- Schnorr signatures ------------------------------------------------
+
+
+def _h_scalar(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(len(part).to_bytes(8, "little"))
+        h.update(part)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def keygen(seed: bytes) -> tuple[bytes, bytes]:
+    """Derive (private_scalar_bytes, public_key_bytes) from a 32-byte seed."""
+    if len(seed) != 32:
+        raise ValueError("seed must be 32 bytes")
+    a = _h_scalar(b"grapevine-tpu-keygen", seed)
+    if a == 0:
+        a = 1
+    pub = (a * BASEPOINT).encode()
+    return a.to_bytes(32, "little"), pub
+
+
+def public_key(sk: bytes) -> bytes:
+    return (int.from_bytes(sk, "little") % L * BASEPOINT).encode()
+
+
+def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
+    """Deterministic context-separated Schnorr signature (64 bytes: R ‖ s)."""
+    a = int.from_bytes(sk, "little") % L
+    if a == 0:
+        raise ValueError("invalid private key")
+    pub = (a * BASEPOINT).encode()
+    r = _h_scalar(_NONCE_DOMAIN, sk, context, message)
+    if r == 0:
+        r = 1
+    big_r = (r * BASEPOINT).encode()
+    k = _h_scalar(_CHAL_DOMAIN, context, big_r, pub, message)
+    s = (r + k * a) % L
+    return big_r + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, context: bytes, message: bytes, signature: bytes) -> bool:
+    """True iff the signature is valid. Never raises on malformed input."""
+    if len(signature) != 64 or len(pub) != 32:
+        return False
+    try:
+        big_r = RistrettoPoint.decode(signature[:32])
+        a_pt = RistrettoPoint.decode(pub)
+    except ValueError:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= L:
+        return False
+    k = _h_scalar(_CHAL_DOMAIN, context, signature[:32], pub, message)
+    return (s * BASEPOINT) == (big_r + k * a_pt)

@@ -43,11 +43,6 @@ def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, pl=None, tag=0)
     )
 
 
-@pytest.fixture(scope="module")
-def engine():
-    return GrapevineEngine(SMALL, seed=3)
-
-
 def assert_responses_equal(dev, ora, ctx=""):
     assert dev.status_code == ora.status_code, f"{ctx}: status {dev.status_code} != {ora.status_code}"
     assert dev.record.msg_id == ora.record.msg_id, f"{ctx}: id"
@@ -246,3 +241,19 @@ def test_expiry_sweep_engine_vs_oracle():
     # freed capacity is reusable
     (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW + 160)
     assert r.status_code == C.STATUS_CODE_SUCCESS
+
+
+def test_expiry_clock_regression_keeps_future_records():
+    """Regression: a sweep clock behind a record's timestamp must not
+    mass-evict via u32 wraparound (oracle uses signed comparison)."""
+    cfg = GrapevineConfig(
+        max_messages=16, max_recipients=4, mailbox_cap=4, batch_size=2,
+        stash_size=64, expiry_period=100,
+    )
+    engine = GrapevineEngine(cfg, seed=8)
+    (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, key(1), recipient=key(2))], NOW)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    assert engine.expire(NOW - 10) == 0  # clock stepped back: keep everything
+    assert engine.message_count() == 1
+    (rr,) = engine.handle_queries([req(C.REQUEST_TYPE_READ, key(2))], NOW)
+    assert rr.status_code == C.STATUS_CODE_SUCCESS

@@ -1,0 +1,118 @@
+"""Session-layer tests: ChaCha20 vectors, ristretto255 vectors, Schnorr,
+channel handshake + framing, challenge lockstep."""
+
+import pytest
+
+from grapevine_tpu.session import chacha, channel, ristretto
+from grapevine_tpu.wire import constants as C
+
+
+def test_chacha20_rfc7539_vector():
+    """RFC 7539 §2.3.2 test vector (key 00..1f, nonce 000000090000004a00000000,
+    counter 1)."""
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    stream = chacha.ChaCha20(key, nonce, counter=1)
+    block = stream.keystream(64)
+    expected = bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4"
+        "c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2"
+        "b5129cd1de164eb9cbd083e8a2503c4e"
+    )
+    assert block == expected
+
+
+def test_challenge_rng_lockstep_and_decoupling():
+    seed = bytes(range(32))
+    a = chacha.ChallengeRng(seed)
+    b = chacha.ChallengeRng(seed)
+    c1, c2 = a.next_challenge(), a.next_challenge()
+    assert [b.next_challenge(), b.next_challenge()] == [c1, c2]
+    assert c1 != c2 and len(c1) == 32
+    # different seed → different stream
+    assert chacha.ChallengeRng(bytes(32)).next_challenge() != c1
+
+
+def test_ristretto_basepoint_vectors():
+    """Small-multiple encodings from the ristretto255 spec (RFC 9496 §A.1)."""
+    B = ristretto.BASEPOINT
+    assert (0 * B).encode() == bytes(32)
+    assert B.encode() == bytes.fromhex(
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76"
+    )
+    assert (2 * B).encode() == bytes.fromhex(
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919"
+    )
+
+
+def test_ristretto_roundtrip_and_group_laws():
+    B = ristretto.BASEPOINT
+    for k in (1, 2, 3, 57, 1000, ristretto.L - 1):
+        pt = k * B
+        assert ristretto.RistrettoPoint.decode(pt.encode()) == pt
+    assert (3 * B) + (4 * B) == 7 * B
+    assert (5 * B) + (-(5 * B)) == ristretto.IDENTITY
+    assert (ristretto.L * B) == ristretto.IDENTITY
+
+
+def test_ristretto_rejects_bad_encodings():
+    with pytest.raises(ValueError):
+        ristretto.RistrettoPoint.decode(b"\xff" * 32)  # ≥ p
+    with pytest.raises(ValueError):
+        ristretto.RistrettoPoint.decode(b"\x01" + b"\x00" * 31)  # negative (odd)
+    with pytest.raises(ValueError):
+        ristretto.RistrettoPoint.decode(b"\x00" * 31)  # wrong length
+
+
+def test_schnorr_sign_verify():
+    sk, pk = ristretto.keygen(b"\x07" * 32)
+    ctx = C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT
+    msg = b"\xAA" * 32
+    sig = ristretto.sign(sk, ctx, msg)
+    assert len(sig) == C.SIGNATURE_SIZE
+    assert ristretto.verify(pk, ctx, msg, sig)
+    # determinism
+    assert ristretto.sign(sk, ctx, msg) == sig
+    # any perturbation fails
+    assert not ristretto.verify(pk, ctx, b"\xAB" + msg[1:], sig)
+    assert not ristretto.verify(pk, b"other-context", msg, sig)
+    assert not ristretto.verify(pk, ctx, msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    sk2, pk2 = ristretto.keygen(b"\x08" * 32)
+    assert not ristretto.verify(pk2, ctx, msg, sig)
+    # malformed inputs return False, never raise
+    assert not ristretto.verify(b"\xff" * 32, ctx, msg, sig)
+    assert not ristretto.verify(pk, ctx, msg, b"short")
+
+
+def test_channel_handshake_and_framing():
+    priv, client_pub = channel.client_handshake()
+    reply, server_chan = channel.server_handshake(client_pub)
+    client_chan = channel.client_finish(priv, reply)
+
+    seed = channel.new_challenge_seed()
+    ct = server_chan.encrypt(seed)
+    assert client_chan.decrypt(ct) == seed
+
+    # bidirectional, multiple frames, constant overhead
+    m1 = b"\x01" * C.QUERY_REQUEST_WIRE_SIZE
+    m2 = b"\x02" * C.QUERY_REQUEST_WIRE_SIZE
+    c1, c2 = client_chan.encrypt(m1), client_chan.encrypt(m2)
+    assert len(c1) == len(c2) == C.QUERY_REQUEST_WIRE_SIZE + 16
+    assert server_chan.decrypt(c1) == m1
+    assert server_chan.decrypt(c2) == m2
+
+    # tampering is detected
+    priv2, pub2 = channel.client_handshake()
+    reply2, server2 = channel.server_handshake(pub2)
+    client2 = channel.client_finish(priv2, reply2)
+    bad = bytearray(client2.encrypt(m1))
+    bad[5] ^= 1
+    with pytest.raises(Exception):
+        server2.decrypt(bytes(bad))
+
+    # out-of-order (nonce desync) fails: a skipped frame breaks the stream
+    c3 = client_chan.encrypt(m1)
+    c4 = client_chan.encrypt(m2)
+    with pytest.raises(Exception):
+        server_chan.decrypt(c4)  # expects c3 first
