@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Headline benchmark: oblivious CRUD throughput of the batched engine.
+
+Mixed create/read/update/delete batches against a 2^16-message bus
+(BASELINE configs 1-3 territory), run on whatever backend JAX selects
+(the real TPU chip under the driver). Prints ONE JSON line:
+
+    {"metric": "oblivious_crud_ops_per_sec", "value": N,
+     "unit": "ops/s", "vs_baseline": N / 1e6}
+
+``vs_baseline`` is measured against the BASELINE.json north-star target
+of 1M oblivious CRUD ops/sec (v5e-8 at 2^24 buckets); the reference
+itself publishes no numbers (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def make_batches(n_batches: int, batch_size: int, seed: int = 7):
+    from grapevine_tpu.engine.state import ID_WORDS, KEY_WORDS, PAYLOAD_WORDS
+
+    rng = np.random.default_rng(seed)
+    idents = rng.integers(1, 2**31, (64, KEY_WORDS)).astype(np.uint32)
+    batches = []
+    for _ in range(n_batches):
+        b = batch_size
+        rt = rng.choice(
+            np.array([1, 1, 2, 2, 3, 4], np.uint32), size=b
+        )  # create-heavy mix; zero-id reads/deletes pop mailboxes
+        auth = idents[rng.integers(0, len(idents), b)]
+        recipient = idents[rng.integers(0, len(idents), b)]
+        msg_id = np.zeros((b, ID_WORDS), np.uint32)
+        explicit = rt == 3  # UPDATE needs nonzero id (grapevine.proto:95)
+        msg_id[explicit] = rng.integers(1, 2**31, (int(explicit.sum()), ID_WORDS))
+        batches.append(
+            {
+                "req_type": rt,
+                "auth": auth,
+                "msg_id": msg_id,
+                "recipient": recipient,
+                "payload": rng.integers(0, 2**31, (b, PAYLOAD_WORDS)).astype(
+                    np.uint32
+                ),
+                "now": np.uint32(1_700_000_000),
+            }
+        )
+    return batches
+
+
+def main():
+    import jax
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.state import EngineConfig, init_engine
+    from grapevine_tpu.engine.step import engine_step
+
+    cfg = GrapevineConfig(
+        max_messages=1 << 16,
+        max_recipients=1 << 12,
+        batch_size=64,
+        stash_size=128,
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    state = init_engine(ecfg, seed=0)
+    step = jax.jit(engine_step, static_argnums=(0,), donate_argnums=(1,))
+
+    batches = make_batches(8, cfg.batch_size)
+
+    # warmup: compile + first dispatch
+    state, resp, _ = step(ecfg, state, batches[0])
+    jax.block_until_ready(resp)
+
+    n_rounds = 16
+    t0 = time.perf_counter()
+    for i in range(n_rounds):
+        state, resp, _ = step(ecfg, state, batches[i % len(batches)])
+    jax.block_until_ready(resp)
+    dt = time.perf_counter() - t0
+
+    ops = n_rounds * cfg.batch_size
+    value = ops / dt
+    print(
+        json.dumps(
+            {
+                "metric": "oblivious_crud_ops_per_sec",
+                "value": round(value, 2),
+                "unit": "ops/s",
+                "vs_baseline": round(value / 1_000_000, 6),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -250,7 +250,12 @@ def _phase_c(ecfg: EngineConfig, value, present, o):
     return mb_pack(ecfg, keys_out, entries_out), keep, jnp.bool_(False), out
 
 
-def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
+def engine_step(
+    ecfg: EngineConfig,
+    state: EngineState,
+    batch: dict,
+    axis_name: str | None = None,
+):
     """Process one fixed-size batch of (already authenticated) requests.
 
     ``batch``: req_type u32[B] (0 = padding dummy), auth u32[B,8],
@@ -260,6 +265,10 @@ def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
     u32[B] (0 for dummies) and full record fields; the transcript is the
     public per-op leaf triple (mailbox, records, mailbox) — identical in
     distribution for every op type.
+
+    ``axis_name`` names the mesh axis when running inside ``shard_map``
+    with the two bucket trees sharded across chips (parallel/mesh.py);
+    everything except tree fetch/write-back is replicated.
     """
     B = batch["req_type"].shape[0]
     now = batch["now"].astype(U32)
@@ -319,6 +328,7 @@ def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
             nl_a,
             o,
             lambda v, p, oo: _phase_a(ecfg, v, p, oo),
+            axis_name,
         )
         o.update(out_a)
 
@@ -342,6 +352,7 @@ def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
             nl_b,
             o,
             lambda v, p, oo: _phase_b(ecfg, v, p, oo),
+            axis_name,
         )
         o.update({"del_ok": out_b["del_ok"], "upd_ok": out_b["upd_ok"]})
 
@@ -359,6 +370,7 @@ def engine_step(ecfg: EngineConfig, state: EngineState, batch: dict):
             nl_c,
             o,
             lambda v, p, oo: _phase_c(ecfg, v, p, oo),
+            axis_name,
         )
 
         recipients = (

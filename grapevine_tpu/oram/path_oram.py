@@ -71,6 +71,13 @@ class OramConfig:
         return (1 << (self.height + 1)) - 1
 
     @property
+    def n_buckets_padded(self) -> int:
+        """Tree arrays are allocated one bucket past the heap (a power of
+        two) so the bucket axis divides evenly across any power-of-two
+        device mesh; heap indices never address the pad bucket."""
+        return 1 << (self.height + 1)
+
+    @property
     def path_len(self) -> int:
         return self.height + 1
 
@@ -101,9 +108,9 @@ def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
     """Empty tree; position map initialized with uniform random leaves."""
     z, v = cfg.bucket_slots, cfg.value_words
     return OramState(
-        tree_idx=jnp.full((cfg.n_buckets, z), SENTINEL, U32),
-        tree_leaf=jnp.zeros((cfg.n_buckets, z), U32),
-        tree_val=jnp.zeros((cfg.n_buckets, z, v), U32),
+        tree_idx=jnp.full((cfg.n_buckets_padded, z), SENTINEL, U32),
+        tree_leaf=jnp.zeros((cfg.n_buckets_padded, z), U32),
+        tree_val=jnp.zeros((cfg.n_buckets_padded, z, v), U32),
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
         stash_leaf=jnp.zeros((cfg.stash_size,), U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
@@ -132,6 +139,43 @@ def _common_prefix_depth(cfg: OramConfig, leaves_a: jax.Array, leaf_b: jax.Array
     return d  # in [0, height]
 
 
+def _path_gather(tree: jax.Array, path_b: jax.Array, axis_name: str | None):
+    """Fetch the path buckets from a (possibly device-sharded) tree array.
+
+    With ``axis_name`` set, the call runs inside ``shard_map`` and ``tree``
+    is the local shard (contiguous heap-index range per device). Each chip
+    contributes the buckets it owns, masked to zero elsewhere, and one
+    ``psum`` over ICI assembles the full path on every chip — the
+    collective form of BASELINE config 5's sharded bucket tree. The
+    addresses touched remain exactly the public path, preserving the
+    transcript."""
+    if axis_name is None:
+        return tree[path_b]
+    n_local = tree.shape[0]
+    base = (jax.lax.axis_index(axis_name) * n_local).astype(U32)
+    loc = path_b - base
+    mine = (path_b >= base) & (path_b < base + U32(n_local))
+    vals = tree[jnp.where(mine, loc, 0)]
+    mask = mine.reshape(mine.shape + (1,) * (vals.ndim - 1))
+    return jax.lax.psum(jnp.where(mask, vals, jnp.zeros_like(vals)), axis_name)
+
+
+def _path_scatter(
+    tree: jax.Array, path_b: jax.Array, new_vals: jax.Array, axis_name: str | None
+):
+    """Write the path buckets back; each chip writes only buckets it owns
+    (every heap index has exactly one owner, so the global write is
+    consistent with no collective)."""
+    if axis_name is None:
+        return tree.at[path_b].set(new_vals)
+    n_local = tree.shape[0]
+    base = (jax.lax.axis_index(axis_name) * n_local).astype(U32)
+    loc = path_b - base
+    mine = (path_b >= base) & (path_b < base + U32(n_local))
+    tgt = jnp.where(mine, loc, U32(n_local))  # out of range = dropped
+    return tree.at[tgt].set(new_vals, mode="drop")
+
+
 def oram_access(
     cfg: OramConfig,
     state: OramState,
@@ -139,6 +183,7 @@ def oram_access(
     new_leaf: jax.Array,  # u32 scalar, fresh uniform in [0, leaves)
     operand,
     fn: Callable,
+    axis_name: str | None = None,
 ):
     """One oblivious read-modify-write access.
 
@@ -153,6 +198,11 @@ def oram_access(
     ``fn`` must itself be branchless; it receives the *masked* value
     (zeros when absent). Returns ``(state', out, leaf)`` where ``leaf`` is
     the public transcript entry for this access.
+
+    With ``axis_name`` set (inside ``shard_map``), the tree arrays are
+    sharded along the bucket axis across the mesh and path fetch/write-back
+    become masked collectives; stash, position map, and all decision logic
+    are replicated — every chip runs the identical branchless program.
     """
     z, v, plen = cfg.bucket_slots, cfg.value_words, cfg.path_len
 
@@ -162,9 +212,9 @@ def oram_access(
     path_b = path_bucket_indices(cfg, leaf)  # u32[plen]
 
     # --- fetch path ∪ stash into the working set -----------------------
-    pidx = state.tree_idx[path_b].reshape(-1)  # u32[plen*z]
-    pleaf = state.tree_leaf[path_b].reshape(-1)
-    pval = state.tree_val[path_b].reshape(-1, v)
+    pidx = _path_gather(state.tree_idx, path_b, axis_name).reshape(-1)
+    pleaf = _path_gather(state.tree_leaf, path_b, axis_name).reshape(-1)
+    pval = _path_gather(state.tree_val, path_b, axis_name).reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
     wleaf = jnp.concatenate([state.stash_leaf, pleaf])
     wval = jnp.concatenate([state.stash_val, pval], axis=0)
@@ -235,9 +285,15 @@ def oram_access(
 
     # --- write the path back (write transcript ≡ read transcript) ------
     new_state = OramState(
-        tree_idx=state.tree_idx.at[path_b].set(new_pidx.reshape(plen, z)),
-        tree_leaf=state.tree_leaf.at[path_b].set(new_pleaf.reshape(plen, z)),
-        tree_val=state.tree_val.at[path_b].set(new_pval.reshape(plen, z, v)),
+        tree_idx=_path_scatter(
+            state.tree_idx, path_b, new_pidx.reshape(plen, z), axis_name
+        ),
+        tree_leaf=_path_scatter(
+            state.tree_leaf, path_b, new_pleaf.reshape(plen, z), axis_name
+        ),
+        tree_val=_path_scatter(
+            state.tree_val, path_b, new_pval.reshape(plen, z, v), axis_name
+        ),
         stash_idx=stash_idx,
         stash_leaf=stash_leaf,
         stash_val=stash_val,
@@ -254,6 +310,7 @@ def oram_access_batch(
     new_leaves: jax.Array,  # u32[B]
     operands,  # pytree with leading batch axis
     fn: Callable,
+    axis_name: str | None = None,
 ):
     """Sequentially-committed batch of accesses under one ``lax.scan``.
 
@@ -267,7 +324,7 @@ def oram_access_batch(
 
     def step(carry, xs):
         idx, new_leaf, opnd = xs
-        carry, out, leaf = oram_access(cfg, carry, idx, new_leaf, opnd, fn)
+        carry, out, leaf = oram_access(cfg, carry, idx, new_leaf, opnd, fn, axis_name)
         return carry, (out, leaf)
 
     state, (outs, leaves) = jax.lax.scan(step, state, (idxs, new_leaves, operands))

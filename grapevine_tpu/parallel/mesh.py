@@ -1,0 +1,105 @@
+"""Bucket-tree sharding over a JAX device mesh.
+
+Design (the TPU re-platforming of "one enclave's EPC holds everything",
+SURVEY.md §1, §2c):
+
+- The two Path-ORAM bucket trees (records + mailbox, the only state that
+  scales with bus capacity) are sharded along the bucket axis: each chip
+  owns a contiguous heap-index range of ``n_buckets_padded / n_chips``
+  buckets in its local HBM.
+- Per access, every chip gathers the path buckets it owns and one
+  ``psum`` over ICI assembles the full root→leaf working set on all chips
+  (oram/path_oram.py:_path_gather) — BASELINE config 5's "stash
+  all-gather over ICI" in reduce form. Write-back is purely local: each
+  heap index has exactly one owner.
+- Stash, position map, freelist, and all scalar bookkeeping are
+  replicated; every chip executes the identical branchless program, so
+  the replicated state stays bit-identical without extra collectives.
+  (The position map at 2^24 entries is 64 MiB — cheap to replicate; the
+  trees are the GBs.)
+
+Communication cost per access: one psum of ``path_len * Z`` slots
+(index + leaf + value words) — for the records tree at 2^24 that is
+25 * 4 * 1 KiB ≈ 100 KiB over ICI per op, overlapped across the batch by
+XLA's scheduler. There is no NCCL/MPI analog anywhere: chip↔chip is XLA
+collectives over ICI, host↔device is one dispatch per batch round
+(SURVEY.md §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.state import EngineConfig, EngineState
+from ..engine.step import engine_step
+from ..oram.path_oram import OramState
+
+#: mesh axis across which the bucket trees are sharded
+TREE_AXIS = "tree"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(devices, (TREE_AXIS,))
+
+
+def _oram_specs() -> OramState:
+    return OramState(
+        tree_idx=P(TREE_AXIS),
+        tree_leaf=P(TREE_AXIS),
+        tree_val=P(TREE_AXIS),
+        stash_idx=P(),
+        stash_leaf=P(),
+        stash_val=P(),
+        posmap=P(),
+        overflow=P(),
+    )
+
+
+def engine_state_specs() -> EngineState:
+    """PartitionSpec pytree matching EngineState: trees sharded, rest replicated."""
+    return EngineState(
+        rec=_oram_specs(),
+        mb=_oram_specs(),
+        freelist=P(),
+        free_top=P(),
+        recipients=P(),
+        seq=P(),
+        hash_key=P(),
+        rng=P(),
+    )
+
+
+def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
+    """Place an engine state onto the mesh per ``engine_state_specs``."""
+    specs = engine_state_specs()
+    return jax.tree.map(
+        lambda s, x: jax.device_put(x, NamedSharding(mesh, s)),
+        specs,
+        state,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
+    """Jit-compiled engine step with the bucket trees sharded over ``mesh``.
+
+    The returned function has the same signature and semantics as
+    ``engine_step(ecfg, state, batch)`` (bit-identical results — tested in
+    tests/test_parallel.py, the analog of the reference's SGX_MODE=SW
+    simulation testing, reference .github/workflows/ci.yaml:15-16).
+    """
+    specs = engine_state_specs()
+    step = jax.shard_map(
+        functools.partial(engine_step, ecfg, axis_name=TREE_AXIS),
+        mesh=mesh,
+        in_specs=(specs, P()),
+        out_specs=(specs, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=0)

@@ -10,6 +10,8 @@ constant-size encrypted QueryRequest.
 
 from __future__ import annotations
 
+import threading
+
 import grpc
 
 from ..session import channel as chan
@@ -41,6 +43,10 @@ class GrapevineClient:
         self._channel: chan.SecureChannel | None = None
         self._challenge: ChallengeRng | None = None
         self._channel_id = b""
+        # challenge draw + AEAD counters + wire round-trip must stay
+        # ordered: an overtaking request desyncs the server's lockstep
+        # challenge RNG permanently (reference README.md:195-196)
+        self._lock = threading.Lock()
 
     # -- connection -----------------------------------------------------
 
@@ -60,20 +66,21 @@ class GrapevineClient:
     def _query(self, req: QueryRequest) -> QueryResponse:
         if self._channel is None or self._challenge is None:
             raise RuntimeError("call auth() first")
-        challenge = self._challenge.next_challenge()
-        req.auth_identity = self.public_key
-        req.auth_signature = ristretto.sign(
-            self.sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
-        )
-        ciphertext = self._channel.encrypt(req.pack())
-        reply = pw.decode_envelope(
-            self._query_rpc(
-                pw.encode_envelope(
-                    pw.EnvelopeMessage(channel_id=self._channel_id, data=ciphertext)
+        with self._lock:
+            challenge = self._challenge.next_challenge()
+            req.auth_identity = self.public_key
+            req.auth_signature = ristretto.sign(
+                self.sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
+            )
+            ciphertext = self._channel.encrypt(req.pack())
+            reply = pw.decode_envelope(
+                self._query_rpc(
+                    pw.encode_envelope(
+                        pw.EnvelopeMessage(channel_id=self._channel_id, data=ciphertext)
+                    )
                 )
             )
-        )
-        return QueryResponse.unpack(self._channel.decrypt(reply.data))
+            return QueryResponse.unpack(self._channel.decrypt(reply.data))
 
     # -- CRUD helpers (reference README.md:162-175) ---------------------
 

@@ -125,11 +125,21 @@ class GrapevineServer:
             envelope = pw.decode_envelope(request_bytes)
         except ValueError as exc:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"malformed envelope: {exc}")
+        now = time.time()
         with self._sessions_lock:
             session = self._sessions.get(envelope.channel_id)
+            # enforce the TTL at use time too: a quiet server (no Auth
+            # traffic) must not serve — or retain — idle-expired sessions
+            if (
+                session is not None
+                and self.session_ttl > 0
+                and now - session.last_used > self.session_ttl
+            ):
+                del self._sessions[envelope.channel_id]
+                session = None
         if session is None:
             context.abort(grpc.StatusCode.UNAUTHENTICATED, "unknown channel")
-        session.last_used = time.time()
+        session.last_used = now
         with session.lock:
             # lockstep: draw the challenge before attempting decryption
             challenge = session.challenge_rng.next_challenge()

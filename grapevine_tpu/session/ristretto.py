@@ -189,12 +189,21 @@ def public_key(sk: bytes) -> bytes:
     return (int.from_bytes(sk, "little") % L * BASEPOINT).encode()
 
 
+#: sk bytes -> encoded public point; sign() is on the client per-request
+#: path and must not redo the basepoint mult every call
+_PUB_CACHE: dict[bytes, bytes] = {}
+
+
 def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
     """Deterministic context-separated Schnorr signature (64 bytes: R ‖ s)."""
     a = int.from_bytes(sk, "little") % L
     if a == 0:
         raise ValueError("invalid private key")
-    pub = (a * BASEPOINT).encode()
+    pub = _PUB_CACHE.get(sk)
+    if pub is None:
+        pub = (a * BASEPOINT).encode()
+        if len(_PUB_CACHE) < 4096:
+            _PUB_CACHE[sk] = pub
     r = _h_scalar(_NONCE_DOMAIN, sk, context, message)
     if r == 0:
         r = 1

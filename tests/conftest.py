@@ -20,3 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone is not enough: plugin site hooks (e.g. the axon PJRT
+# tunnel's sitecustomize) may pin the platform via jax.config, which
+# overrides JAX_PLATFORMS. jax.config wins over both, as long as it runs
+# before backend initialization — conftest import is early enough.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

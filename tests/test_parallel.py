@@ -1,0 +1,88 @@
+"""Sharded engine ≡ single-chip engine, bit for bit.
+
+Test pyramid item (5) from SURVEY.md §4: multi-chip = single-chip results
+under sharding, on the virtual 8-device CPU mesh (the stand-in for real
+hardware, the way the reference CI's SGX_MODE=SW simulator stands in for
+SGX, reference .github/workflows/ci.yaml:15-16).
+"""
+
+import numpy as np
+import jax
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import pack_batch
+from grapevine_tpu.engine.state import EngineConfig, init_engine
+from grapevine_tpu.engine.step import engine_step
+from grapevine_tpu.parallel import make_mesh, make_sharded_step, shard_engine_state
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+CFG = GrapevineConfig(
+    max_messages=64,
+    max_recipients=8,
+    mailbox_cap=4,
+    batch_size=4,
+    stash_size=64,
+)
+
+
+def key(n: int) -> bytes:
+    return bytes([n, n ^ 0x5A]) + b"\x01" * 30
+
+
+def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id,
+            recipient=recipient,
+            payload=bytes([tag & 0xFF]) * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def test_sharded_step_matches_single_chip():
+    assert len(jax.devices()) >= 8, "conftest forces an 8-device CPU mesh"
+    ecfg = EngineConfig.from_config(CFG)
+
+    state = init_engine(ecfg, seed=3)
+    single = jax.jit(engine_step, static_argnums=(0,))
+
+    mesh = make_mesh(jax.devices()[:8])
+    sstate = shard_engine_state(init_engine(ecfg, seed=3), mesh)
+    sstep = make_sharded_step(ecfg, mesh)
+
+    a, b, c = key(1), key(2), key(3)
+    batches = [
+        [req(C.REQUEST_TYPE_CREATE, a, recipient=b, tag=7),
+         req(C.REQUEST_TYPE_CREATE, a, recipient=c, tag=8),
+         req(C.REQUEST_TYPE_CREATE, c, recipient=b, tag=9)],
+        [req(C.REQUEST_TYPE_READ, b),
+         req(C.REQUEST_TYPE_DELETE, c),
+         req(C.REQUEST_TYPE_READ, b, msg_id=b"\x99" * 16)],
+        [req(C.REQUEST_TYPE_DELETE, b),
+         req(C.REQUEST_TYPE_READ, b),
+         req(C.REQUEST_TYPE_CREATE, b, recipient=a, tag=10)],
+    ]
+
+    for i, reqs in enumerate(batches):
+        batch = pack_batch(reqs, ecfg.batch_size, NOW + i)
+        state, resp1, tr1 = single(ecfg, state, batch)
+        sstate, resp2, tr2 = sstep(sstate, batch)
+        for k in resp1:
+            assert np.array_equal(np.asarray(resp1[k]), np.asarray(resp2[k])), (
+                f"batch {i}: response field {k} diverged"
+            )
+        assert np.array_equal(np.asarray(tr1), np.asarray(tr2)), (
+            f"batch {i}: transcript diverged"
+        )
+
+    # full final state equality, including both bucket trees
+    flat1, _ = jax.tree.flatten(state)
+    flat2, _ = jax.tree.flatten(sstate)
+    for x, y in zip(flat1, flat2):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
