@@ -57,7 +57,7 @@ def main():
 
     from grapevine_tpu.config import GrapevineConfig
     from grapevine_tpu.engine.state import EngineConfig, init_engine
-    from grapevine_tpu.engine.step import engine_step
+    from grapevine_tpu.engine.round_step import engine_round_step
 
     cfg = GrapevineConfig(
         max_messages=1 << 16,
@@ -67,7 +67,7 @@ def main():
     )
     ecfg = EngineConfig.from_config(cfg)
     state = init_engine(ecfg, seed=0)
-    step = jax.jit(engine_step, static_argnums=(0,), donate_argnums=(1,))
+    step = jax.jit(engine_round_step, static_argnums=(0,), donate_argnums=(1,))
 
     batches = make_batches(8, cfg.batch_size)
 
@@ -81,6 +81,10 @@ def main():
         state, resp, _ = step(ecfg, state, batches[i % len(batches)])
     jax.block_until_ready(resp)
     dt = time.perf_counter() - t0
+
+    # a run that overflowed the stash (dropped blocks) is not a valid number
+    overflow = int(np.asarray(state.rec.overflow)) + int(np.asarray(state.mb.overflow))
+    assert overflow == 0, f"stash overflow during bench: {overflow}"
 
     ops = n_rounds * cfg.batch_size
     value = ops / dt

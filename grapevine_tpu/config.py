@@ -45,6 +45,19 @@ class GrapevineConfig:
     batch_size: int = 8
     #: mailboxes per hash bucket (one bucket = one mailbox-ORAM block)
     mailbox_slots: int = 4
+    #: within-batch commit schedule: "phase" = phase-major batched rounds
+    #: (engine/round_step.py — the production path: one path fetch per
+    #: ORAM round instead of one per op), "op" = op-major sequential
+    #: commits (engine/step.py — the original reference-shaped engine).
+    #: Identical semantics for single-op batches; batch-hazard semantics
+    #: documented in round_step.py.
+    commit: str = "phase"
+
+    def __post_init__(self):
+        if self.commit not in ("phase", "op"):
+            raise ValueError(
+                f"commit must be 'phase' or 'op', got {self.commit!r}"
+            )
     #: per-slot load target; table buckets = ceil(
     #: max_recipients / (mailbox_slots * mailbox_load)). Low load keeps the
     #: single-choice hash table's overflow probability negligible; a

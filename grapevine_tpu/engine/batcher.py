@@ -32,6 +32,7 @@ from .state import (
     PAYLOAD_WORDS,
     init_engine,
 )
+from .round_step import engine_round_step
 from .step import engine_step
 
 
@@ -112,7 +113,8 @@ class GrapevineEngine:
         self.config = config or GrapevineConfig()
         self.ecfg = EngineConfig.from_config(self.config)
         self.state: EngineState = init_engine(self.ecfg, seed)
-        self._step = jax.jit(engine_step, static_argnums=(0,))
+        step_fn = engine_round_step if self.config.commit == "phase" else engine_step
+        self._step = jax.jit(step_fn, static_argnums=(0,))
         self._sweep = jax.jit(expiry_sweep, static_argnums=(0,))
         self._lock = threading.Lock()
 

@@ -1,0 +1,80 @@
+"""Response/status assembly shared by the op-major and phase-major engines.
+
+Both engines end with the identical mapping from phase outputs to the
+constant-shape response record + status code (the status-precedence tree
+documented in testing/reference.py). The helper is shape-generic: the
+op-major engine calls it per op under `lax.scan` (scalar masks), the
+phase-major engine calls it once per batch (``[B]`` masks) — `[..., None]`
+broadcasting covers both.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..wire import constants as C
+
+U32 = jnp.uint32
+
+
+def assemble_responses(
+    *,
+    is_real,
+    is_create,
+    is_update,
+    is_delete,
+    id_zero,
+    status_a,
+    create_ok,
+    out_b,
+    new_id,
+    auth,
+    recipient,
+    payload,
+    now,
+):
+    """Build the response pytree. All mask args are bool scalars or
+    bool[B]; multi-word fields have one trailing word axis."""
+    ok_rud = out_b["read_ok"] | out_b["upd_ok"] | out_b["del_ok"]
+    status = jnp.where(
+        ~is_real,
+        U32(0),
+        jnp.where(
+            is_create,
+            status_a,
+            jnp.where(
+                ok_rud,
+                U32(C.STATUS_CODE_SUCCESS),
+                jnp.where(
+                    (is_update | is_delete)
+                    & ~id_zero
+                    & out_b["match_ok"]
+                    & out_b["auth_ok"]
+                    & ~out_b["recip_match"],
+                    U32(C.STATUS_CODE_INVALID_RECIPIENT),
+                    U32(C.STATUS_CODE_NOT_FOUND),
+                ),
+            ),
+        ),
+    )
+    created = is_create & create_ok
+    cr = created[..., None]
+    okr = ok_rud[..., None]
+    return {
+        "status": status,
+        "msg_id": jnp.where(cr, new_id, jnp.where(okr, out_b["resp_id"], U32(0))),
+        "sender": jnp.where(
+            cr, auth, jnp.where(okr, out_b["resp_sender"], U32(0))
+        ),
+        "recipient": jnp.where(
+            cr, recipient, jnp.where(okr, out_b["resp_recipient"], U32(0))
+        ),
+        "timestamp": jnp.where(
+            created | ok_rud,
+            jnp.where(created, now, out_b["resp_ts"]),
+            jnp.where(is_real, now, U32(0)),
+        ),
+        "payload": jnp.where(
+            cr, payload, jnp.where(okr, out_b["resp_payload"], U32(0))
+        ),
+    }

@@ -1,0 +1,242 @@
+"""Phase-major batched engine step: three ORAM rounds per batch.
+
+`engine/step.py` commits each op's three phases before the next op starts
+(op-major), which serializes 3·B dependent path fetches. This module runs
+the same three phases *phase-major* over the batched round primitive
+(oram/round.py): one mailbox round applying phase A for every op in slot
+order, one records round applying phase B, one mailbox round applying
+phase C. The semantic phase functions are shared with the op-major engine
+verbatim — only the commit schedule differs.
+
+**Phase-major commit semantics** (the documented batch-hazard behavior of
+this engine; the reference never faced batches, SURVEY.md §7.6). Within
+one batch, in slot order:
+
+- phase-A effects (mailbox capacity checks and appends for CREATE,
+  zero-id selection, zero-id DELETE's mailbox pop, record-slot
+  reservation) are visible to later ops' phase A;
+- phase-B effects (record insert/mutate/remove) are visible to later
+  ops' phase B;
+- phase-C effects (explicit DELETE's mailbox removal, UPDATE's mailbox
+  timestamp refresh) are visible only to the *next* batch — as are
+  record slots freed by any DELETE.
+
+Consequences, all mirrored bit-for-bit by the CPU oracle's
+``handle_batch`` (testing/reference.py): a CREATE cannot reuse capacity
+freed by a DELETE in the same batch; a zero-id op whose mailbox-selected
+message was explicitly deleted earlier in the batch reports NOT_FOUND
+(the record is already gone in phase B) rather than selecting the next
+message. For single-op batches phase-major and op-major semantics are
+identical (no cross-op window), which tests assert.
+
+Obliviousness: the public transcript is one uniform leaf per op per
+round, [mailbox, records, mailbox] — identical in distribution for every
+op type including padding dummies; duplicate-index dedup inside
+oram_round keeps same-key ops uncorrelated in the transcript.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..oblivious.primitives import is_zero_words
+from ..wire import constants as C
+from ..oram.round import oram_round
+from .responses import assemble_responses
+from .state import EngineConfig, EngineState, mb_bucket_hash
+from .step import _phase_a, _phase_b, _phase_c
+
+U32 = jnp.uint32
+
+
+def engine_round_step(
+    ecfg: EngineConfig,
+    state: EngineState,
+    batch: dict,
+    axis_name: str | None = None,
+):
+    """Process one batch as three phase-major ORAM rounds.
+
+    Same signature and return shape as `engine_step`: ``(state',
+    responses, transcripts u32[B, 3])``.
+    """
+    b = batch["req_type"].shape[0]
+    now = batch["now"].astype(U32)
+    rt = batch["req_type"].astype(U32)
+    auth = batch["auth"]
+    msg_id = batch["msg_id"]
+    recipient = batch["recipient"]
+    payload = batch["payload"]
+
+    keys = jax.random.split(state.rng, 8)
+    k_next = keys[7]
+    nl_a, nl_b, nl_c = (
+        jax.random.bits(keys[0], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[1], (b,), U32) & U32(ecfg.rec.leaves - 1),
+        jax.random.bits(keys[2], (b,), U32) & U32(ecfg.mb.leaves - 1),
+    )
+    dl_a, dl_b, dl_c = (
+        jax.random.bits(keys[3], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[4], (b,), U32) & U32(ecfg.rec.leaves - 1),
+        jax.random.bits(keys[5], (b,), U32) & U32(ecfg.mb.leaves - 1),
+    )
+    id_rand = jax.random.bits(keys[6], (b, 3), U32)
+
+    is_create = rt == C.REQUEST_TYPE_CREATE
+    is_read = rt == C.REQUEST_TYPE_READ
+    is_update = rt == C.REQUEST_TYPE_UPDATE
+    is_delete = rt == C.REQUEST_TYPE_DELETE
+    is_real = is_create | is_read | is_update | is_delete
+    id_zero = is_zero_words(msg_id)
+    zero_recip = is_zero_words(recipient)
+
+    ka = jnp.where((is_create | ~id_zero)[:, None], recipient, auth)
+    bucket = jax.vmap(
+        lambda k: mb_bucket_hash(state.hash_key, k, ecfg.mb_table_buckets)
+    )(ka)
+    idxs_mb = jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index))
+
+    # ---- round A: mailbox (capacity, append, zero-id select/pop) ------
+    opnd_a = {
+        "ka": ka,
+        "idr": id_rand,
+        "is_create": is_create & is_real,
+        "is_delete": is_delete,
+        "id_zero": id_zero,
+        "zero_recip": zero_recip,
+    }
+
+    def apply_a(carry, value, present, o):
+        freelist, free_top, recipients, seq = carry
+        can_alloc = free_top > 0
+        alloc_pos = jnp.where(can_alloc, free_top - 1, 0)
+        alloc_idx = freelist[alloc_pos]
+        new_id = jnp.stack(
+            [alloc_idx, o["idr"][0] | U32(1), o["idr"][1], o["idr"][2]]
+        )
+        oo = {
+            **o,
+            "can_alloc": can_alloc,
+            "alloc_idx": alloc_idx,
+            "new_id": new_id,
+            "recipients": recipients,
+            "seq": seq,
+            "now": now,
+        }
+        new_value, keep, insert, out = _phase_a(ecfg, value, present, oo)
+        out = {**out, "alloc_idx": alloc_idx, "new_id": new_id}
+        free_top = free_top - out["create_ok"].astype(U32)
+        recipients = (recipients.astype(jnp.int32) + out["recip_delta"]).astype(U32)
+        seq = seq + out["create_ok"].astype(U32)
+        return (freelist, free_top, recipients, seq), new_value, keep, insert, out
+
+    mb1, (freelist, free_top, recipients, seq), out_a, leaf_a = oram_round(
+        ecfg.mb,
+        state.mb,
+        idxs_mb,
+        nl_a,
+        dl_a,
+        opnd_a,
+        apply_a,
+        (state.freelist, state.free_top, state.recipients, state.seq),
+        axis_name,
+    )
+
+    # ---- round B: records (verify, insert, mutate, remove) ------------
+    create_ok = out_a["create_ok"]
+    lookup_blk = jnp.where(
+        create_ok,
+        out_a["alloc_idx"],
+        jnp.where(id_zero, out_a["sel_blk"], msg_id[:, 0]),
+    )
+    real_b = is_real & (
+        create_ok | (~is_create & (~id_zero | out_a["sel_found"]))
+    )
+    idx_b = jnp.where(
+        real_b, lookup_blk & U32(ecfg.rec.leaves - 1), U32(ecfg.rec.dummy_index)
+    )
+    opnd_b = {
+        "sel_blk": out_a["sel_blk"],
+        "sel_idw": out_a["sel_idw"],
+        "msg_id": msg_id,
+        "id_zero": id_zero,
+        "is_create": is_create & is_real,
+        "is_read": is_read,
+        "is_update": is_update,
+        "is_delete": is_delete,
+        "auth": auth,
+        "recipient": recipient,
+        "payload": payload,
+        "create_ok": create_ok,
+        "new_id": out_a["new_id"],
+        "idx_b": idx_b,
+    }
+
+    def apply_b(carry, value, present, o):
+        new_value, keep, insert, out = _phase_b(ecfg, value, present, {**o, "now": now})
+        freelist, free_top = carry
+        push_pos = jnp.where(out["del_ok"], free_top, U32(ecfg.max_messages))
+        freelist = freelist.at[push_pos].set(o["idx_b"], mode="drop")
+        free_top = free_top + out["del_ok"].astype(U32)
+        return (freelist, free_top), new_value, keep, insert, out
+
+    rec1, (freelist, free_top), out_b, leaf_b = oram_round(
+        ecfg.rec,
+        state.rec,
+        idx_b,
+        nl_b,
+        dl_b,
+        opnd_b,
+        apply_b,
+        (freelist, free_top),
+        axis_name,
+    )
+
+    # ---- round C: mailbox finalization --------------------------------
+    opnd_c = {
+        "ka": ka,
+        "msg_id": msg_id,
+        "del_ok": out_b["del_ok"],
+        "upd_ok": out_b["upd_ok"],
+        "rm_a": out_a["rm_a"],
+    }
+
+    def apply_c(carry, value, present, o):
+        new_value, keep, insert, out = _phase_c(ecfg, value, present, {**o, "now": now})
+        recipients = (carry.astype(jnp.int32) + out["recip_delta"]).astype(U32)
+        return recipients, new_value, keep, insert, out
+
+    mb2, recipients, _out_c, leaf_c = oram_round(
+        ecfg.mb, mb1, idxs_mb, nl_c, dl_c, opnd_c, apply_c, recipients, axis_name
+    )
+
+    # ---- response assembly (shared with the op-major engine) ----------
+    responses = assemble_responses(
+        is_real=is_real,
+        is_create=is_create,
+        is_update=is_update,
+        is_delete=is_delete,
+        id_zero=id_zero,
+        status_a=out_a["status_a"],
+        create_ok=create_ok,
+        out_b=out_b,
+        new_id=out_a["new_id"],
+        auth=auth,
+        recipient=recipient,
+        payload=payload,
+        now=now,
+    )
+    transcripts = jnp.stack([leaf_a, leaf_b, leaf_c], axis=1)
+
+    new_state = EngineState(
+        rec=rec1,
+        mb=mb2,
+        freelist=freelist,
+        free_top=free_top,
+        recipients=recipients,
+        seq=seq,
+        hash_key=state.hash_key,
+        rng=k_next,
+    )
+    return new_state, responses, transcripts

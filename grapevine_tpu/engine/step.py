@@ -45,6 +45,7 @@ from ..oblivious.primitives import (
 )
 from ..wire import constants as C
 from ..oram.path_oram import oram_access
+from .responses import assemble_responses
 from .state import (
     ENT_BLK,
     ENT_IDW,
@@ -380,51 +381,22 @@ def engine_step(
         ).astype(U32)
         seq = carry.seq + out_a["create_ok"].astype(U32)
 
-        # -- response assembly -----------------------------------------
-        ok_rud = out_b["read_ok"] | out_b["upd_ok"] | out_b["del_ok"]
-        status = jnp.where(
-            ~is_real,
-            U32(0),
-            jnp.where(
-                is_create,
-                out_a["status_a"],
-                jnp.where(
-                    ok_rud,
-                    U32(C.STATUS_CODE_SUCCESS),
-                    jnp.where(
-                        (is_update | is_delete)
-                        & ~id_zero
-                        & out_b["match_ok"]
-                        & out_b["auth_ok"]
-                        & ~out_b["recip_match"],
-                        U32(C.STATUS_CODE_INVALID_RECIPIENT),
-                        U32(C.STATUS_CODE_NOT_FOUND),
-                    ),
-                ),
-            ),
+        # -- response assembly (shared with the phase-major engine) -----
+        resp = assemble_responses(
+            is_real=is_real,
+            is_create=is_create,
+            is_update=is_update,
+            is_delete=is_delete,
+            id_zero=id_zero,
+            status_a=out_a["status_a"],
+            create_ok=out_a["create_ok"],
+            out_b=out_b,
+            new_id=new_id,
+            auth=auth,
+            recipient=recipient,
+            payload=payload,
+            now=now,
         )
-        created = is_create & out_a["create_ok"]
-        zid = jnp.zeros((4,), U32)
-        zkey = jnp.zeros((8,), U32)
-        zpl = jnp.zeros_like(payload)
-        resp = {
-            "status": status,
-            "msg_id": jnp.where(created, new_id, jnp.where(ok_rud, out_b["resp_id"], zid)),
-            "sender": jnp.where(
-                created, auth, jnp.where(ok_rud, out_b["resp_sender"], zkey)
-            ),
-            "recipient": jnp.where(
-                created, recipient, jnp.where(ok_rud, out_b["resp_recipient"], zkey)
-            ),
-            "timestamp": jnp.where(
-                created | ok_rud,
-                jnp.where(created, now, out_b["resp_ts"]),
-                jnp.where(is_real, now, U32(0)),
-            ),
-            "payload": jnp.where(
-                created, payload, jnp.where(ok_rud, out_b["resp_payload"], zpl)
-            ),
-        }
         transcript = jnp.stack([leaf_a, leaf_b, leaf_c])
 
         carry = EngineState(

@@ -161,17 +161,28 @@ def _path_gather(tree: jax.Array, path_b: jax.Array, axis_name: str | None):
 
 
 def _path_scatter(
-    tree: jax.Array, path_b: jax.Array, new_vals: jax.Array, axis_name: str | None
+    tree: jax.Array,
+    path_b: jax.Array,
+    new_vals: jax.Array,
+    axis_name: str | None,
+    owner: jax.Array | None = None,
 ):
     """Write the path buckets back; each chip writes only buckets it owns
     (every heap index has exactly one owner, so the global write is
-    consistent with no collective)."""
+    consistent with no collective). ``owner`` optionally masks out slots
+    that must not be written at all (round.py's duplicate-bucket copies);
+    masked slots are dropped via out-of-range targets."""
     if axis_name is None:
-        return tree.at[path_b].set(new_vals)
+        if owner is None:
+            return tree.at[path_b].set(new_vals)
+        tgt = jnp.where(owner, path_b, U32(tree.shape[0]))
+        return tree.at[tgt].set(new_vals, mode="drop")
     n_local = tree.shape[0]
     base = (jax.lax.axis_index(axis_name) * n_local).astype(U32)
     loc = path_b - base
     mine = (path_b >= base) & (path_b < base + U32(n_local))
+    if owner is not None:
+        mine = mine & owner
     tgt = jnp.where(mine, loc, U32(n_local))  # out of range = dropped
     return tree.at[tgt].set(new_vals, mode="drop")
 

@@ -1,0 +1,225 @@
+"""Batched Path-ORAM access rounds: one fetch, N ops, one eviction.
+
+The sequential engine (`oram_access_batch`) commits each access as its own
+path fetch → stash scan → evict → write-back, so a B-op batch costs 3·B
+dependent HBM round trips — latency-bound on TPU. This module implements
+the OPRAM-style *batched round* instead (cf. the batching discussion in
+PAPERS.md and SURVEY.md §7 "hard parts" 6):
+
+1. **Dedup + fetch**: each op's path is resolved up front. Duplicate
+   logical indices within the round do a *dummy* fetch of a fresh random
+   path after the first occurrence — the classic OPRAM conflict trick.
+   This is also a security requirement, not just an optimization: if two
+   ops on one key both fetched ``posmap[idx]`` the transcript would show
+   two identical leaves, correlating ops on the same key. With dedup every
+   transcript entry is an independent uniform leaf. All B paths are then
+   fetched in one gather; buckets shared by several paths (always true
+   near the root) are attributed to a single *owner* path slot and
+   invalidated elsewhere, so each live block enters the working set once.
+2. **Apply**: the fetched blocks join the stash in one combined working
+   set. Ops are applied in slot order (the documented within-batch commit
+   order, SURVEY.md §7.6) under a `lax.scan`, but each step is O(W + V):
+   a match scan over the W-entry index vector plus one row gather/update
+   at the matched position. The row gather is a secret-position access
+   into *private working memory* — the same standing the flat position
+   map already has (see the threat model in path_oram.py): obliviousness
+   is claimed for the HBM bucket-tree transcript, and the working set,
+   like the stash and position map, is EPC-analog private state.
+3. **Evict**: one level-synchronous greedy pass assigns every working-set
+   entry to the deepest fetched bucket on its own path, jointly across
+   all B paths (an entry's path meets each level in exactly one bucket,
+   so levels vectorize with no conflicts). Leftovers recompact into the
+   stash; one scatter writes all owned buckets back (write transcript ≡
+   read transcript).
+
+Net effect per round: 2 large HBM transfers (gather + scatter) per tree
+array instead of 2·B small dependent ones, and the only remaining
+sequential chain is the cheap apply scan.
+
+Semantics note: `apply_fn` threads an engine carry through the ops, which
+is what lets the query engine keep its capacity counters sequentially
+consistent inside a round (engine/round_step.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..oblivious.primitives import SENTINEL, rank_of
+from .path_oram import (
+    OramConfig,
+    OramState,
+    _path_gather,
+    _path_scatter,
+    path_bucket_indices,
+)
+
+U32 = jnp.uint32
+
+
+def occurrence_masks(idxs: jax.Array, dummy_index: int):
+    """(first_occ, last_occ) over real (non-dummy) indices.
+
+    first_occ[i]: no earlier op in the round touches the same index —
+    this op performs the real path fetch. last_occ[i]: no later op does —
+    this op's fresh leaf wins the position-map remap.
+    """
+    is_real = idxs != U32(dummy_index)
+    eq = (idxs[:, None] == idxs[None, :]) & is_real[:, None] & is_real[None, :]
+    b = idxs.shape[0]
+    earlier = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)
+    first_occ = is_real & ~jnp.any(eq & earlier, axis=1)
+    last_occ = is_real & ~jnp.any(eq & earlier.T, axis=1)
+    return first_occ, last_occ
+
+
+def _owner_mask(flat_b: jax.Array) -> jax.Array:
+    """fowner[k]: flat path-slot k is the first occurrence of its bucket.
+
+    Shared buckets (all paths share the root; prefixes shared pairwise)
+    must contribute their blocks to the working set exactly once, and be
+    written back exactly once.
+    """
+    n = flat_b.shape[0]
+    eq = flat_b[:, None] == flat_b[None, :]
+    earlier = jnp.tril(jnp.ones((n, n), jnp.bool_), k=-1)
+    return ~jnp.any(eq & earlier, axis=1)
+
+
+def oram_round(
+    cfg: OramConfig,
+    state: OramState,
+    idxs: jax.Array,  # u32[B] block indices (cfg.dummy_index = dummy op)
+    new_leaves: jax.Array,  # u32[B] fresh uniform leaves (remap targets)
+    dummy_leaves: jax.Array,  # u32[B] fresh uniform leaves (dummy fetches)
+    operands,  # pytree, leading batch axis
+    apply_fn,
+    carry,
+    axis_name: str | None = None,
+):
+    """One batched oblivious access round over this ORAM.
+
+    ``apply_fn(carry, value u32[V], present bool, operand) ->
+    (carry, new_value u32[V], keep bool, insert bool, out pytree)`` with
+    the same branchless contract as `oram_access`'s ``fn``, plus the
+    threaded engine carry.
+
+    Returns ``(state', carry, outs, leaves)``; ``leaves`` u32[B] is the
+    public transcript (every entry an independent uniform draw).
+    """
+    b = idxs.shape[0]
+    z, v, plen, h = cfg.bucket_slots, cfg.value_words, cfg.path_len, cfg.height
+    s = cfg.stash_size
+    nslots = b * plen * z
+
+    # --- 1. dedup, position-map read/remap, path fetch -----------------
+    first_occ, last_occ = occurrence_masks(idxs, cfg.dummy_index)
+    leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
+    # last occurrence wins the remap; others retarget the throwaway
+    # dummy-index slot (posmap[leaves] backs cfg.dummy_index)
+    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.leaves))
+    posmap = state.posmap.at[remap_tgt].set(new_leaves)
+
+    path_b = jax.vmap(lambda lf: path_bucket_indices(cfg, lf))(leaves)  # [B,plen]
+    flat_b = path_b.reshape(b * plen)
+    fowner = _owner_mask(flat_b)
+
+    pidx = _path_gather(state.tree_idx, flat_b, axis_name)  # [B*plen, z]
+    pleaf = _path_gather(state.tree_leaf, flat_b, axis_name)
+    pval = _path_gather(state.tree_val, flat_b, axis_name)
+    # non-owner copies of shared buckets are invalidated
+    pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
+
+    widx = jnp.concatenate([state.stash_idx, pidx.reshape(-1)])
+    wleaf = jnp.concatenate([state.stash_leaf, pleaf.reshape(-1)])
+    wval = jnp.concatenate([state.stash_val, pval.reshape(-1, v)], axis=0)
+    w = s + nslots
+
+    # --- 2. slot-order apply over the combined working set -------------
+    def step(sc, xs):
+        widx, wleaf, wval, carry, dropped = sc
+        idx, new_leaf, opnd = xs
+        match = (widx == idx) & (widx != SENTINEL)
+        present = jnp.any(match)
+        pos = jnp.argmax(match)  # 0 when absent; guarded below
+        raw = wval[pos]
+        value = jnp.where(present, raw, jnp.zeros_like(raw))
+
+        carry, new_value, keep, insert, out = apply_fn(carry, value, present, opnd)
+
+        # in-place modify (writes are no-ops when absent)
+        widx = widx.at[pos].set(
+            jnp.where(present & ~keep, SENTINEL, widx[pos])
+        )
+        wleaf = wleaf.at[pos].set(jnp.where(present, new_leaf, wleaf[pos]))
+        wval = wval.at[pos].set(jnp.where(present, new_value, raw))
+
+        do_insert = insert & ~present & (idx != U32(cfg.dummy_index))
+        free = widx == SENTINEL
+        has_free = jnp.any(free)
+        fpos = jnp.argmax(free)
+        ins = do_insert & has_free
+        widx = widx.at[fpos].set(jnp.where(ins, idx, widx[fpos]))
+        wleaf = wleaf.at[fpos].set(jnp.where(ins, new_leaf, wleaf[fpos]))
+        wval = wval.at[fpos].set(jnp.where(ins, new_value, wval[fpos]))
+        dropped = dropped + (do_insert & ~has_free).astype(U32)
+        return (widx, wleaf, wval, carry, dropped), out
+
+    (widx, wleaf, wval, carry, insert_dropped), outs = jax.lax.scan(
+        step,
+        (widx, wleaf, wval, carry, jnp.zeros((), U32)),
+        (idxs, new_leaves, operands),
+    )
+
+    # --- 3. joint level-synchronous greedy eviction --------------------
+    valid = widx != SENTINEL
+    placed = jnp.zeros((w,), jnp.bool_)
+    slot_tgt = jnp.full((w,), nslots, U32)  # OOB = not placed
+    col_owner = fowner.reshape(b, plen)  # [B, plen]
+    for level in range(h, -1, -1):
+        # the one bucket on each entry's own path at this level
+        hb = (U32(1) << U32(level)) - U32(1) + (wleaf >> U32(h - level))
+        colb = path_b[:, level]  # [B] buckets fetched at this level
+        m = (hb[:, None] == colb[None, :]) & col_owner[None, :, level]  # [W,B]
+        elig = valid & ~placed & jnp.any(m, axis=1)
+        me = m & elig[:, None]
+        mi = me.astype(jnp.int32)
+        rank = jnp.sum((jnp.cumsum(mi, axis=0) - mi) * mi, axis=1)  # within-col
+        chosen = elig & (rank < z)
+        col = jnp.argmax(m, axis=1).astype(U32)  # unique column per entry
+        slot = (col * U32(plen) + U32(level)) * U32(z) + rank.astype(U32)
+        slot_tgt = jnp.where(chosen, slot, slot_tgt)
+        placed = placed | chosen
+
+    new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(widx, mode="drop")
+    new_pleaf = jnp.zeros((nslots,), U32).at[slot_tgt].set(wleaf, mode="drop")
+    new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(wval, mode="drop")
+
+    # --- 4. stash recompaction + write-back ----------------------------
+    leftover = valid & ~placed
+    srank = rank_of(leftover)
+    starget = jnp.where(leftover, srank, s)  # OOB = dropped
+    stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(widx, mode="drop")
+    stash_leaf = jnp.zeros((s,), U32).at[starget].set(wleaf, mode="drop")
+    stash_val = jnp.zeros((s, v), U32).at[starget].set(wval, mode="drop")
+    n_left = jnp.sum(leftover.astype(jnp.int32))
+    stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
+
+    new_state = OramState(
+        tree_idx=_path_scatter(
+            state.tree_idx, flat_b, new_pidx.reshape(b * plen, z), axis_name, fowner
+        ),
+        tree_leaf=_path_scatter(
+            state.tree_leaf, flat_b, new_pleaf.reshape(b * plen, z), axis_name, fowner
+        ),
+        tree_val=_path_scatter(
+            state.tree_val, flat_b, new_pval.reshape(b * plen, z, v), axis_name, fowner
+        ),
+        stash_idx=stash_idx,
+        stash_leaf=stash_leaf,
+        stash_val=stash_val,
+        posmap=posmap,
+        overflow=state.overflow + stash_dropped + insert_dropped,
+    )
+    return new_state, carry, outs, leaves

@@ -51,17 +51,25 @@ class GrapevineClient:
     # -- connection -----------------------------------------------------
 
     def auth(self, attestation=None) -> None:
-        """Run the key exchange and seed the challenge RNG."""
+        """Run the key exchange and seed the challenge RNG.
+
+        Holds the same lock as ``_query``: a re-auth racing an in-flight
+        request would otherwise mix the old challenge RNG with the new
+        channel and permanently desync the server's lockstep RNG.
+        """
         priv, pub = chan.client_handshake()
-        reply = pw.decode_auth_with_seed(
-            self._auth_rpc(pw.encode_auth_message(pw.AuthMessage(data=pub)))
-        )
-        self._channel = chan.client_finish(priv, reply.auth_message.data, attestation)
-        payload = self._channel.decrypt(reply.encrypted_challenge_seed)
-        # seed (32) ‖ server-assigned session token (the channel id)
-        seed, token = payload[:32], payload[32:]
-        self._challenge = ChallengeRng(seed)
-        self._channel_id = token
+        with self._lock:
+            reply = pw.decode_auth_with_seed(
+                self._auth_rpc(pw.encode_auth_message(pw.AuthMessage(data=pub)))
+            )
+            self._channel = chan.client_finish(
+                priv, reply.auth_message.data, attestation
+            )
+            payload = self._channel.decrypt(reply.encrypted_challenge_seed)
+            # seed (32) ‖ server-assigned session token (the channel id)
+            seed, token = payload[:32], payload[32:]
+            self._challenge = ChallengeRng(seed)
+            self._channel_id = token
 
     def _query(self, req: QueryRequest) -> QueryResponse:
         if self._channel is None or self._challenge is None:

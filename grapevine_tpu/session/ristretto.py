@@ -18,6 +18,7 @@ service. A constant-time native implementation is a later hardening item.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 
 P = 2**255 - 19
@@ -185,13 +186,11 @@ def keygen(seed: bytes) -> tuple[bytes, bytes]:
     return a.to_bytes(32, "little"), pub
 
 
+@functools.lru_cache(maxsize=4096)
 def public_key(sk: bytes) -> bytes:
+    """sk bytes → encoded public point. LRU-cached: sign() is on the
+    client per-request path and must not redo the basepoint mult."""
     return (int.from_bytes(sk, "little") % L * BASEPOINT).encode()
-
-
-#: sk bytes -> encoded public point; sign() is on the client per-request
-#: path and must not redo the basepoint mult every call
-_PUB_CACHE: dict[bytes, bytes] = {}
 
 
 def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
@@ -199,11 +198,7 @@ def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
     a = int.from_bytes(sk, "little") % L
     if a == 0:
         raise ValueError("invalid private key")
-    pub = _PUB_CACHE.get(sk)
-    if pub is None:
-        pub = (a * BASEPOINT).encode()
-        if len(_PUB_CACHE) < 4096:
-            _PUB_CACHE[sk] = pub
+    pub = public_key(sk)
     r = _h_scalar(_NONCE_DOMAIN, sk, context, message)
     if r == 0:
         r = 1

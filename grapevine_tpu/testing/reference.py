@@ -209,6 +209,135 @@ class ReferenceEngine:
         self._remove_mailbox_entry(rec.recipient, msg_id)
         return self._ok(rec)
 
+    # -- phase-major batch mode (mirrors engine/round_step.py) ----------
+
+    def handle_batch(
+        self,
+        reqs: list[QueryRequest],
+        now: int,
+        forced_msg_ids: list[bytes | None] | None = None,
+    ) -> list[QueryResponse]:
+        """Handle one batch under **phase-major commit semantics**.
+
+        The batched device engine (engine/round_step.py) commits each of
+        its three phases for the whole batch before the next phase:
+        mailbox effects (A), record effects (B), mailbox finalization (C).
+        This oracle method replays exactly that schedule with plain dicts;
+        see round_step.py's module docstring for the semantics and their
+        consequences. For single-op batches it coincides with
+        ``handle_query``.
+        """
+        n = len(reqs)
+        forced = forced_msg_ids or [None] * n
+        for req in reqs:
+            req.validate()
+            if req.auth_identity == C.ZERO_PUBKEY:
+                raise HardProtocolError("auth identity must be nonzero")
+            if not (1 <= req.request_type <= 4):
+                raise HardProtocolError(f"invalid request type {req.request_type}")
+            if (
+                req.request_type == C.REQUEST_TYPE_UPDATE
+                and req.record.msg_id == C.ZERO_MSG_ID
+            ):
+                raise HardProtocolError("UPDATE with zero msg_id")
+        now = int(now)
+        if now <= 0:
+            raise ValueError("server clock must be positive")
+
+        # ---- phase A: mailbox decisions and effects, slot order --------
+        # statuses decided here stay final for CREATE; zero-id ops record
+        # their selected message id
+        status_a: list[int | None] = [None] * n
+        selected: list[bytes | None] = [None] * n
+        create_ok = [False] * n
+        msg_ids: list[bytes | None] = [None] * n
+        free_at_start = self.config.max_messages - len(self.records)
+        creates_so_far = 0
+        for i, req in enumerate(reqs):
+            rt = req.request_type
+            if rt == C.REQUEST_TYPE_CREATE:
+                recipient = req.record.recipient
+                box = self.mailboxes.get(recipient)
+                if recipient == C.ZERO_PUBKEY:
+                    status_a[i] = C.STATUS_CODE_INVALID_RECIPIENT
+                elif free_at_start - creates_so_far <= 0:
+                    # record slots freed by same-batch deletes are not
+                    # reusable until the next batch (phase-major rule)
+                    status_a[i] = C.STATUS_CODE_TOO_MANY_MESSAGES
+                elif box is None and len(self.mailboxes) >= self.config.max_recipients:
+                    status_a[i] = C.STATUS_CODE_TOO_MANY_RECIPIENTS
+                elif box is not None and len(box) >= self.config.mailbox_cap:
+                    status_a[i] = C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT
+                else:
+                    mid = forced[i] if forced[i] is not None else self._assign_msg_id()
+                    create_ok[i] = True
+                    creates_so_far += 1
+                    msg_ids[i] = mid
+                    self.mailboxes.setdefault(recipient, []).append(mid)
+                    status_a[i] = C.STATUS_CODE_SUCCESS
+            elif req.record.msg_id == C.ZERO_MSG_ID:
+                selected[i] = self._next_msg_id(req.auth_identity)
+                if rt == C.REQUEST_TYPE_DELETE and selected[i] is not None:
+                    # zero-id pop removes the mailbox entry in phase A
+                    self._remove_mailbox_entry(req.auth_identity, selected[i])
+
+        # ---- phase B: record effects, slot order -----------------------
+        out: list[QueryResponse | None] = [None] * n
+        deferred_c: list[tuple[int, bytes, bytes]] = []  # (slot, recipient, msg_id)
+        for i, req in enumerate(reqs):
+            rt = req.request_type
+            if rt == C.REQUEST_TYPE_CREATE:
+                if not create_ok[i]:
+                    out[i] = _zero_response(now, status_a[i])
+                    continue
+                record = Record(
+                    msg_id=msg_ids[i],
+                    sender=req.auth_identity,
+                    recipient=req.record.recipient,
+                    timestamp=now,
+                    payload=req.record.payload,
+                )
+                self.records[msg_ids[i]] = record
+                out[i] = self._ok(record)
+                continue
+
+            mid = (
+                selected[i] if req.record.msg_id == C.ZERO_MSG_ID else req.record.msg_id
+            )
+            rec = (
+                self._lookup_authorized(mid, req.auth_identity)
+                if mid is not None
+                else None
+            )
+            if rec is None:
+                out[i] = _zero_response(now, C.STATUS_CODE_NOT_FOUND)
+                continue
+            if rt == C.REQUEST_TYPE_READ:
+                out[i] = self._ok(rec)
+            elif rt == C.REQUEST_TYPE_UPDATE:
+                if req.record.recipient != rec.recipient:
+                    out[i] = _zero_response(now, C.STATUS_CODE_INVALID_RECIPIENT)
+                else:
+                    rec.payload = req.record.payload
+                    rec.timestamp = now
+                    out[i] = self._ok(rec)
+            else:  # DELETE
+                if req.record.msg_id == C.ZERO_MSG_ID:
+                    del self.records[mid]  # mailbox entry already popped in A
+                    out[i] = self._ok(rec)
+                elif req.record.recipient != rec.recipient:
+                    out[i] = _zero_response(now, C.STATUS_CODE_INVALID_RECIPIENT)
+                else:
+                    del self.records[mid]
+                    deferred_c.append((i, rec.recipient, mid))
+                    out[i] = self._ok(rec)
+
+        # ---- phase C: mailbox finalization, slot order -----------------
+        for _i, recipient, mid in deferred_c:
+            self._remove_mailbox_entry(recipient, mid)
+
+        return out  # type: ignore[return-value]
+
     # -- expiry sweep (README.md:86-98) ---------------------------------
 
     def expire(self, now: int, period: int | None = None) -> int:

@@ -1,4 +1,4 @@
-"""Device engine vs CPU oracle: result equality on random op sequences,
+"""Op-major device engine vs CPU oracle: result equality on random op sequences,
 R/U/D transcript indistinguishability, expiry, and capacity reuse.
 
 Test pyramid items (2), (4) from SURVEY.md §4.
@@ -23,6 +23,7 @@ SMALL = GrapevineConfig(
     mailbox_cap=4,
     batch_size=8,
     stash_size=64,
+    commit="op",
 )
 
 
@@ -117,7 +118,7 @@ def test_engine_matches_oracle_random_ops():
 
 def test_mailbox_cap_and_capacity_reuse():
     cfg = GrapevineConfig(
-        max_messages=8, max_recipients=4, mailbox_cap=3, batch_size=4, stash_size=64
+        max_messages=8, max_recipients=4, mailbox_cap=3, batch_size=4, stash_size=64, commit="op"
     )
     engine = GrapevineEngine(cfg, seed=5)
     a, b = key(1), key(2)
@@ -212,7 +213,7 @@ def test_delete_with_half_guessed_id_mutates_nothing():
 def test_expiry_sweep_engine_vs_oracle():
     cfg = GrapevineConfig(
         max_messages=32, max_recipients=8, mailbox_cap=4, batch_size=4,
-        stash_size=64, expiry_period=100,
+        stash_size=64, expiry_period=100, commit="op",
     )
     engine = GrapevineEngine(cfg, seed=6)
     oracle = ReferenceEngine(config=cfg, rng=random.Random(1))
@@ -248,7 +249,7 @@ def test_expiry_clock_regression_keeps_future_records():
     mass-evict via u32 wraparound (oracle uses signed comparison)."""
     cfg = GrapevineConfig(
         max_messages=16, max_recipients=4, mailbox_cap=4, batch_size=2,
-        stash_size=64, expiry_period=100,
+        stash_size=64, expiry_period=100, commit="op",
     )
     engine = GrapevineEngine(cfg, seed=8)
     (r,) = engine.handle_queries([req(C.REQUEST_TYPE_CREATE, key(1), recipient=key(2))], NOW)
