@@ -37,22 +37,30 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
     period = U32(period)
 
     # --- records ORAM: invalidate expired blocks -----------------------
-    def sweep_records(idx, val):
+    def sweep_records(idx, ts):
         live = idx != SENTINEL
-        dead = live & _expired(val[..., REC_TS], now, period)
+        dead = live & _expired(ts, now, period)
         return jnp.where(dead, SENTINEL, idx)
 
     rec = state.rec
-    rec_tree_idx = sweep_records(rec.tree_idx, rec.tree_val)
-    rec_stash_idx = sweep_records(rec.stash_idx, rec.stash_val)
-    rec = rec._replace(tree_idx=rec_tree_idx, stash_idx=rec_stash_idx)
+    z, v = ecfg.rec.bucket_slots, ecfg.rec.value_words
+    # tree_idx is flat [n*Z]; per-slot timestamps are a V-strided slice
+    # of the [n, Z*V] value rows — no relayout of the big array
+    rec_tree_idx = sweep_records(
+        rec.tree_idx.reshape(-1, z), rec.tree_val[:, REC_TS::v][:, :z]
+    )
+    rec_stash_idx = sweep_records(rec.stash_idx, rec.stash_val[:, REC_TS])
+    rec = rec._replace(
+        tree_idx=rec_tree_idx.reshape(-1), stash_idx=rec_stash_idx
+    )
 
     # --- mailbox ORAM: clear expired entries, drop empty mailboxes -----
     def sweep_mb(idx, val):
-        # val: [..., V]; vectorize the parse over leading dims
-        lead = val.shape[:-1]
-        flat = val.reshape((-1, val.shape[-1]))
+        # idx: [...]; val: tree [n, Z*V] or stash [S, V] — one block per
+        # idx entry either way once flattened to rows of V words
+        lead = idx.shape
         k, cap = ecfg.mb_slots, ecfg.mailbox_cap
+        flat = val.reshape((-1, k * (8 + 4 * cap)))
         keys = flat.reshape(-1, k, 8 + 4 * cap)[:, :, :8]
         entries = flat.reshape(-1, k, 8 + 4 * cap)[:, :, 8:].reshape(-1, k, cap, 4)
         valid = entries[..., ENT_SEQ] != 0
@@ -71,10 +79,13 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
         return new_idx, out.reshape(val.shape), keys.reshape(lead + (k, 8))
 
     mb = state.mb
-    mb_tree_idx, mb_tree_val, tree_keys = sweep_mb(mb.tree_idx, mb.tree_val)
+    zm = ecfg.mb.bucket_slots
+    mb_tree_idx, mb_tree_val, tree_keys = sweep_mb(
+        mb.tree_idx.reshape(-1, zm), mb.tree_val
+    )
     mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(mb.stash_idx, mb.stash_val)
     mb = mb._replace(
-        tree_idx=mb_tree_idx,
+        tree_idx=mb_tree_idx.reshape(-1),
         tree_val=mb_tree_val,
         stash_idx=mb_stash_idx,
         stash_val=mb_stash_val,
@@ -93,7 +104,7 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
     # --- rebuild the free-block list from surviving record indices -----
     n = ecfg.max_messages
     present = jnp.zeros((n,), jnp.bool_)
-    for idx in (rec.tree_idx.reshape(-1), rec.stash_idx.reshape(-1)):
+    for idx in (rec_tree_idx.reshape(-1), rec_stash_idx.reshape(-1)):
         safe = jnp.where(idx != SENTINEL, idx, n)  # OOB drops
         present = present.at[safe].set(True, mode="drop")
     order = jnp.argsort(present, stable=True)  # free (False) indices first

@@ -40,7 +40,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..oblivious.primitives import is_zero_words
+from ..oblivious.primitives import is_zero_words, rank_of
 from ..wire import constants as C
 from ..oram.round import oram_round
 from .responses import assemble_responses
@@ -98,6 +98,16 @@ def engine_round_step(
     idxs_mb = jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index))
 
     # ---- round A: mailbox (capacity, append, zero-id select/pop) ------
+    # Freelist discipline: the big freelist array never enters a scan
+    # carry (a mode="drop" scatter on a capacity-sized array inside a
+    # scan body stalls every iteration on a fresh copy — profiled at
+    # ~25 ms/round at 2^20). Instead the top B candidate blocks are
+    # pre-gathered here; the scan only advances a counter; frees are
+    # pushed back in one vectorized scatter after round B.
+    ks = jnp.arange(b, dtype=U32)
+    cand_pos = jnp.where(ks < state.free_top, state.free_top - U32(1) - ks, 0)
+    cand_idx = state.freelist[cand_pos]
+
     opnd_a = {
         "ka": ka,
         "idr": id_rand,
@@ -108,10 +118,9 @@ def engine_round_step(
     }
 
     def apply_a(carry, value, present, o):
-        freelist, free_top, recipients, seq = carry
-        can_alloc = free_top > 0
-        alloc_pos = jnp.where(can_alloc, free_top - 1, 0)
-        alloc_idx = freelist[alloc_pos]
+        n_alloc, recipients, seq = carry
+        can_alloc = n_alloc < state.free_top
+        alloc_idx = cand_idx[jnp.minimum(n_alloc, U32(b - 1))]
         new_id = jnp.stack(
             [alloc_idx, o["idr"][0] | U32(1), o["idr"][1], o["idr"][2]]
         )
@@ -126,12 +135,12 @@ def engine_round_step(
         }
         new_value, keep, insert, out = _phase_a(ecfg, value, present, oo)
         out = {**out, "alloc_idx": alloc_idx, "new_id": new_id}
-        free_top = free_top - out["create_ok"].astype(U32)
+        n_alloc = n_alloc + out["create_ok"].astype(U32)
         recipients = (recipients.astype(jnp.int32) + out["recip_delta"]).astype(U32)
         seq = seq + out["create_ok"].astype(U32)
-        return (freelist, free_top, recipients, seq), new_value, keep, insert, out
+        return (n_alloc, recipients, seq), new_value, keep, insert, out
 
-    mb1, (freelist, free_top, recipients, seq), out_a, leaf_a = oram_round(
+    mb1, (n_alloc, recipients, seq), out_a, leaf_a = oram_round(
         ecfg.mb,
         state.mb,
         idxs_mb,
@@ -139,9 +148,10 @@ def engine_round_step(
         dl_a,
         opnd_a,
         apply_a,
-        (state.freelist, state.free_top, state.recipients, state.seq),
+        (jnp.zeros((), U32), state.recipients, state.seq),
         axis_name,
     )
+    free_top = state.free_top - n_alloc
 
     # ---- round B: records (verify, insert, mutate, remove) ------------
     create_ok = out_a["create_ok"]
@@ -170,18 +180,13 @@ def engine_round_step(
         "payload": payload,
         "create_ok": create_ok,
         "new_id": out_a["new_id"],
-        "idx_b": idx_b,
     }
 
     def apply_b(carry, value, present, o):
         new_value, keep, insert, out = _phase_b(ecfg, value, present, {**o, "now": now})
-        freelist, free_top = carry
-        push_pos = jnp.where(out["del_ok"], free_top, U32(ecfg.max_messages))
-        freelist = freelist.at[push_pos].set(o["idx_b"], mode="drop")
-        free_top = free_top + out["del_ok"].astype(U32)
-        return (freelist, free_top), new_value, keep, insert, out
+        return carry, new_value, keep, insert, out
 
-    rec1, (freelist, free_top), out_b, leaf_b = oram_round(
+    rec1, _, out_b, leaf_b = oram_round(
         ecfg.rec,
         state.rec,
         idx_b,
@@ -189,9 +194,18 @@ def engine_round_step(
         dl_b,
         opnd_b,
         apply_b,
-        (freelist, free_top),
+        jnp.zeros((), U32),
         axis_name,
     )
+
+    # freed blocks return to the freelist in slot order — one vectorized
+    # scatter, visible only to the next batch (round_step commit schedule)
+    dels = out_b["del_ok"]
+    push_pos = jnp.where(
+        dels, free_top + rank_of(dels).astype(U32), U32(ecfg.max_messages)
+    )
+    freelist = state.freelist.at[push_pos].set(idx_b, mode="drop")
+    free_top = free_top + jnp.sum(dels.astype(U32))
 
     # ---- round C: mailbox finalization --------------------------------
     opnd_c = {

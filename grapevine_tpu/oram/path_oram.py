@@ -4,10 +4,17 @@ Re-designs the reference's storage layer (upstream ``mc-oblivious-ram``'s
 PathORAM-4096-Z4 over ``aligned-cmov``; named at reference README.md:16,49
 and SURVEY.md §2b) for TPU:
 
-- the bucket tree is a structure-of-arrays resident in HBM: per-slot block
-  index, assigned leaf, and a ``value_words``-wide uint32 payload;
-- the position map is a flat uint32 array (recursion deferred; SURVEY.md
-  §7.4) living in *private* memory — see the threat model below;
+- the bucket tree lives in HBM as two arrays chosen for XLA-TPU layout
+  behavior (each alternative was measured to force multi-GB relayout
+  copies or pathological strided slices — see the layout note on
+  ``OramState``): a flat 1-D slot-index array ``tree_idx[n*Z]`` and a
+  2-D value array ``tree_val[n, Z*V]`` whose 4080-byte rows match
+  upstream's PathORAM-4096 bucket granularity;
+- per-block leaf assignments are **not** stored in the tree: the flat
+  position map in private memory is authoritative, and working-set
+  leaves are one private gather away. (Upstream stores leaves in bucket
+  metadata because its enclave cannot afford a big in-EPC posmap; here
+  the posmap is already resident private state.)
 - the stash is a fixed-size array scanned with masked selects (the
   vectorized constant-time linear scan);
 - eviction is the textbook greedy deepest-first assignment, computed as
@@ -92,13 +99,22 @@ class OramConfig:
 
 
 class OramState(NamedTuple):
-    """SoA ORAM state; a pytree (NamedTuple) so it jits/shards cleanly."""
+    """ORAM state; a pytree (NamedTuple) so it jits/shards cleanly.
 
-    tree_idx: jax.Array  # u32[n_buckets, Z]; SENTINEL = empty
-    tree_leaf: jax.Array  # u32[n_buckets, Z]
-    tree_val: jax.Array  # u32[n_buckets, Z, V]
+    Layout note (all measured on v5e, see git history): a 3-D value
+    array ``[n, Z, V]`` with V=255 makes XLA relayout-copy the whole
+    tree on gather (8 GB HLO temp, OOM at 2^20 capacity); narrow 2-D
+    metadata ``[n, Z]`` gets a transposed ``{0,1}`` layout whose path
+    slices dominate the round; a fully packed ``[n, Z*(2+V)]`` row
+    (1028 words) is not lane-aligned, padding every row to 1152 words
+    and again relayout-copying the tree. The split below keeps the
+    value rows exactly ``Z*V`` words (1020 rec / 4096 mb — tile-clean)
+    and the slot metadata 1-D, which XLA never transposes.
+    """
+
+    tree_idx: jax.Array  # u32[n_buckets * Z] flat; SENTINEL = empty slot
+    tree_val: jax.Array  # u32[n_buckets, Z*V]; one row per bucket
     stash_idx: jax.Array  # u32[S]
-    stash_leaf: jax.Array  # u32[S]
     stash_val: jax.Array  # u32[S, V]
     posmap: jax.Array  # u32[leaves + 1] (last entry backs the dummy index)
     overflow: jax.Array  # u32 scalar, sticky count of dropped blocks
@@ -108,11 +124,9 @@ def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
     """Empty tree; position map initialized with uniform random leaves."""
     z, v = cfg.bucket_slots, cfg.value_words
     return OramState(
-        tree_idx=jnp.full((cfg.n_buckets_padded, z), SENTINEL, U32),
-        tree_leaf=jnp.zeros((cfg.n_buckets_padded, z), U32),
-        tree_val=jnp.zeros((cfg.n_buckets_padded, z, v), U32),
+        tree_idx=jnp.full((cfg.n_buckets_padded * z,), SENTINEL, U32),
+        tree_val=jnp.zeros((cfg.n_buckets_padded, z * v), U32),
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
-        stash_leaf=jnp.zeros((cfg.stash_size,), U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
         posmap=jax.random.randint(
             key, (cfg.leaves + 1,), 0, cfg.leaves, dtype=jnp.int32
@@ -140,11 +154,11 @@ def _common_prefix_depth(cfg: OramConfig, leaves_a: jax.Array, leaf_b: jax.Array
 
 
 def _path_gather(tree: jax.Array, path_b: jax.Array, axis_name: str | None):
-    """Fetch the path buckets from a (possibly device-sharded) tree array.
+    """Fetch the path bucket rows from a (possibly device-sharded) array.
 
     With ``axis_name`` set, the call runs inside ``shard_map`` and ``tree``
-    is the local shard (contiguous heap-index range per device). Each chip
-    contributes the buckets it owns, masked to zero elsewhere, and one
+    is the local shard (contiguous range per device along axis 0). Each
+    chip contributes the rows it owns, masked to zero elsewhere, and one
     ``psum`` over ICI assembles the full path on every chip — the
     collective form of BASELINE config 5's sharded bucket tree. The
     addresses touched remain exactly the public path, preserving the
@@ -167,14 +181,14 @@ def _path_scatter(
     axis_name: str | None,
     owner: jax.Array | None = None,
 ):
-    """Write the path buckets back; each chip writes only buckets it owns
+    """Write the path rows back; each chip writes only rows it owns
     (every heap index has exactly one owner, so the global write is
     consistent with no collective). ``owner`` optionally masks out slots
     that must not be written at all (round.py's duplicate-bucket copies);
     masked slots are dropped via out-of-range targets."""
     if axis_name is None:
         if owner is None:
-            return tree.at[path_b].set(new_vals)
+            return tree.at[path_b].set(new_vals, unique_indices=True)
         tgt = jnp.where(owner, path_b, U32(tree.shape[0]))
         return tree.at[tgt].set(new_vals, mode="drop")
     n_local = tree.shape[0]
@@ -185,6 +199,23 @@ def _path_scatter(
         mine = mine & owner
     tgt = jnp.where(mine, loc, U32(n_local))  # out of range = dropped
     return tree.at[tgt].set(new_vals, mode="drop")
+
+
+def path_slot_indices(cfg: OramConfig, path_b: jax.Array) -> jax.Array:
+    """Flat tree_idx slot indices for path buckets: [...,] → [..., Z]."""
+    z = cfg.bucket_slots
+    return path_b[..., None] * U32(z) + jnp.arange(z, dtype=U32)[None, :]
+
+
+def working_leaves(
+    state_posmap: jax.Array, cfg: OramConfig, idxs: jax.Array
+) -> jax.Array:
+    """Leaf assignment for working-set entries from the private posmap.
+
+    SENTINEL/dummy slots read the throwaway posmap entry (cfg.leaves);
+    their value is never used (eviction masks invalid entries)."""
+    safe = jnp.where(idxs < U32(cfg.leaves), idxs, U32(cfg.leaves))
+    return state_posmap[safe]
 
 
 def oram_access(
@@ -221,14 +252,16 @@ def oram_access(
     posmap = state.posmap.at[idx].set(new_leaf)
 
     path_b = path_bucket_indices(cfg, leaf)  # u32[plen]
+    slot_b = path_slot_indices(cfg, path_b).reshape(-1)  # u32[plen*z]
 
     # --- fetch path ∪ stash into the working set -----------------------
-    pidx = _path_gather(state.tree_idx, path_b, axis_name).reshape(-1)
-    pleaf = _path_gather(state.tree_leaf, path_b, axis_name).reshape(-1)
+    pidx = _path_gather(state.tree_idx, slot_b, axis_name)
     pval = _path_gather(state.tree_val, path_b, axis_name).reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
-    wleaf = jnp.concatenate([state.stash_leaf, pleaf])
     wval = jnp.concatenate([state.stash_val, pval], axis=0)
+    # leaves come from the (already remapped) private posmap: for the
+    # accessed block that is new_leaf, for others their current leaf
+    wleaf = working_leaves(posmap, cfg, widx)
 
     valid = widx != SENTINEL
     match = valid & (widx == idx)
@@ -239,7 +272,6 @@ def oram_access(
 
     # --- apply the modification obliviously ----------------------------
     wval = jnp.where(match[:, None], new_value[None, :], wval)
-    wleaf = jnp.where(match, new_leaf, wleaf)
     drop = match & ~keep
     widx = jnp.where(drop, SENTINEL, widx)
 
@@ -272,7 +304,6 @@ def oram_access(
     # (level, pos) pair is chosen at most once)
     target = jnp.where(placed, assign * z + pos, plen * z)  # OOB = dropped
     new_pidx = jnp.full((plen * z,), SENTINEL, U32).at[target].set(widx, mode="drop")
-    new_pleaf = jnp.zeros((plen * z,), U32).at[target].set(wleaf, mode="drop")
     new_pval = jnp.zeros((plen * z, v), U32).at[target].set(wval, mode="drop")
 
     # --- compact the leftovers back into the stash ---------------------
@@ -282,7 +313,6 @@ def oram_access(
     stash_idx = jnp.full((cfg.stash_size,), SENTINEL, U32).at[starget].set(
         widx, mode="drop"
     )
-    stash_leaf = jnp.zeros((cfg.stash_size,), U32).at[starget].set(wleaf, mode="drop")
     stash_val = jnp.zeros((cfg.stash_size, v), U32).at[starget].set(wval, mode="drop")
     stash_dropped = jnp.sum(leftover) - jnp.minimum(
         jnp.sum(leftover), cfg.stash_size
@@ -296,17 +326,11 @@ def oram_access(
 
     # --- write the path back (write transcript ≡ read transcript) ------
     new_state = OramState(
-        tree_idx=_path_scatter(
-            state.tree_idx, path_b, new_pidx.reshape(plen, z), axis_name
-        ),
-        tree_leaf=_path_scatter(
-            state.tree_leaf, path_b, new_pleaf.reshape(plen, z), axis_name
-        ),
+        tree_idx=_path_scatter(state.tree_idx, slot_b, new_pidx, axis_name),
         tree_val=_path_scatter(
-            state.tree_val, path_b, new_pval.reshape(plen, z, v), axis_name
+            state.tree_val, path_b, new_pval.reshape(plen, z * v), axis_name
         ),
         stash_idx=stash_idx,
-        stash_leaf=stash_leaf,
         stash_val=stash_val,
         posmap=posmap,
         overflow=overflow,

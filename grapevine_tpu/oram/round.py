@@ -16,15 +16,24 @@ PAPERS.md and SURVEY.md §7 "hard parts" 6):
    fetched in one gather; buckets shared by several paths (always true
    near the root) are attributed to a single *owner* path slot and
    invalidated elsewhere, so each live block enters the working set once.
-2. **Apply**: the fetched blocks join the stash in one combined working
-   set. Ops are applied in slot order (the documented within-batch commit
-   order, SURVEY.md §7.6) under a `lax.scan`, but each step is O(W + V):
-   a match scan over the W-entry index vector plus one row gather/update
-   at the matched position. The row gather is a secret-position access
-   into *private working memory* — the same standing the flat position
-   map already has (see the threat model in path_oram.py): obliviousness
-   is claimed for the HBM bucket-tree transcript, and the working set,
-   like the stash and position map, is EPC-analog private state.
+2. **Apply**: ops are applied in slot order (the documented within-batch
+   commit order, SURVEY.md §7.6) under a `lax.scan`, but the scan never
+   carries the W-row working set — that would spill VMEM at large
+   batches (measured: a 68× working-set carry collapses throughput ~35×
+   past the VMEM limit). Instead each op's *initial* match row is
+   precomputed with one static [B, W] compare + one B-row gather, and
+   within-round read-after-write is resolved through a B-slot *chain
+   buffer*: ops on the same logical key share the slot of the key's
+   first occurrence, each op reads its chain slot (latest value + alive
+   bit) and writes back its result. The scan carry is O(B·V), fully
+   VMEM-resident at any sane batch. The chain-slot row gather is a
+   secret-position access into *private working memory* — the same
+   standing the flat position map already has (see the threat model in
+   path_oram.py): obliviousness is claimed for the HBM bucket-tree
+   transcript, and the working set, like the stash and position map, is
+   EPC-analog private state. After the scan, each key's final
+   (value, alive, leaf) is scattered back to its working-set row — net
+   inserts go to B reserved rows — and eviction proceeds as before.
 3. **Evict**: one level-synchronous greedy pass assigns every working-set
    entry to the deepest fetched bucket on its own path, jointly across
    all B paths (an entry's path meets each level in exactly one bucket,
@@ -53,17 +62,21 @@ from .path_oram import (
     _path_gather,
     _path_scatter,
     path_bucket_indices,
+    path_slot_indices,
+    working_leaves,
 )
 
 U32 = jnp.uint32
 
 
 def occurrence_masks(idxs: jax.Array, dummy_index: int):
-    """(first_occ, last_occ) over real (non-dummy) indices.
+    """(first_occ, last_occ, chain_slot) over real (non-dummy) indices.
 
     first_occ[i]: no earlier op in the round touches the same index —
     this op performs the real path fetch. last_occ[i]: no later op does —
-    this op's fresh leaf wins the position-map remap.
+    this op's fresh leaf wins the position-map remap. chain_slot[i]: the
+    slot of the round's first op on the same index (dummies get their own
+    slot) — the shared chain-buffer slot for within-round read-after-write.
     """
     is_real = idxs != U32(dummy_index)
     eq = (idxs[:, None] == idxs[None, :]) & is_real[:, None] & is_real[None, :]
@@ -71,7 +84,9 @@ def occurrence_masks(idxs: jax.Array, dummy_index: int):
     earlier = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)
     first_occ = is_real & ~jnp.any(eq & earlier, axis=1)
     last_occ = is_real & ~jnp.any(eq & earlier.T, axis=1)
-    return first_occ, last_occ
+    slot_iota = jnp.arange(b, dtype=U32)
+    chain_slot = jnp.where(is_real, jnp.argmax(eq, axis=1).astype(U32), slot_iota)
+    return first_occ, last_occ, chain_slot
 
 
 def _owner_mask(flat_b: jax.Array) -> jax.Array:
@@ -114,7 +129,7 @@ def oram_round(
     nslots = b * plen * z
 
     # --- 1. dedup, position-map read/remap, path fetch -----------------
-    first_occ, last_occ = occurrence_masks(idxs, cfg.dummy_index)
+    first_occ, last_occ, chain_slot = occurrence_masks(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
     # last occurrence wins the remap; others retarget the throwaway
     # dummy-index slot (posmap[leaves] backs cfg.dummy_index)
@@ -125,52 +140,78 @@ def oram_round(
     flat_b = path_b.reshape(b * plen)
     fowner = _owner_mask(flat_b)
 
-    pidx = _path_gather(state.tree_idx, flat_b, axis_name)  # [B*plen, z]
-    pleaf = _path_gather(state.tree_leaf, flat_b, axis_name)
-    pval = _path_gather(state.tree_val, flat_b, axis_name)
+    slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
+    pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(b * plen, z)
+    pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
     # non-owner copies of shared buckets are invalidated
     pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
 
-    widx = jnp.concatenate([state.stash_idx, pidx.reshape(-1)])
-    wleaf = jnp.concatenate([state.stash_leaf, pleaf.reshape(-1)])
-    wval = jnp.concatenate([state.stash_val, pval.reshape(-1, v)], axis=0)
-    w = s + nslots
+    widx0 = jnp.concatenate([state.stash_idx, pidx.reshape(-1)])
+    wval0 = jnp.concatenate([state.stash_val, pval.reshape(-1, v)], axis=0)
+    w = s + nslots + b  # + b reserved rows for net inserts
 
-    # --- 2. slot-order apply over the combined working set -------------
+    # --- 2. slot-order apply via the B-slot chain buffer ---------------
+    # Initial presence: one static [B, W] compare against the (immutable
+    # during apply) working set + one B-row gather. Block indices are
+    # unique among live blocks, so each op matches at most one row.
+    match0 = (widx0[None, :] == idxs[:, None]) & (widx0 != SENTINEL)[None, :]
+    present0 = jnp.any(match0, axis=1)  # bool[B]
+    pos0 = jnp.argmax(match0, axis=1).astype(U32)  # u32[B]; 0 when absent
+    vals0 = wval0[pos0.astype(jnp.int32)]  # u32[B, V]
+
+    slot_iota = jnp.arange(b, dtype=U32)
+
     def step(sc, xs):
-        widx, wleaf, wval, carry, dropped = sc
-        idx, new_leaf, opnd = xs
-        match = (widx == idx) & (widx != SENTINEL)
-        present = jnp.any(match)
-        pos = jnp.argmax(match)  # 0 when absent; guarded below
-        raw = wval[pos]
+        em_set, em_alive, em_val, carry = sc
+        j, idx, cslot, opnd = xs
+        chained = em_set[cslot]
+        chain_val = em_val[cslot]
+        chain_alive = em_alive[cslot]
+        present = jnp.where(chained, chain_alive, present0[j])
+        raw = jnp.where(chained, chain_val, vals0[j])
         value = jnp.where(present, raw, jnp.zeros_like(raw))
 
         carry, new_value, keep, insert, out = apply_fn(carry, value, present, opnd)
 
-        # in-place modify (writes are no-ops when absent)
-        widx = widx.at[pos].set(
-            jnp.where(present & ~keep, SENTINEL, widx[pos])
-        )
-        wleaf = wleaf.at[pos].set(jnp.where(present, new_leaf, wleaf[pos]))
-        wval = wval.at[pos].set(jnp.where(present, new_value, raw))
+        real = idx != U32(cfg.dummy_index)
+        alive = jnp.where(present, keep, insert & real)
+        em_set = em_set.at[cslot].set(em_set[cslot] | real)
+        em_alive = em_alive.at[cslot].set(alive)
+        em_val = em_val.at[cslot].set(jnp.where(present | insert, new_value, raw))
+        return (em_set, em_alive, em_val, carry), out
 
-        do_insert = insert & ~present & (idx != U32(cfg.dummy_index))
-        free = widx == SENTINEL
-        has_free = jnp.any(free)
-        fpos = jnp.argmax(free)
-        ins = do_insert & has_free
-        widx = widx.at[fpos].set(jnp.where(ins, idx, widx[fpos]))
-        wleaf = wleaf.at[fpos].set(jnp.where(ins, new_leaf, wleaf[fpos]))
-        wval = wval.at[fpos].set(jnp.where(ins, new_value, wval[fpos]))
-        dropped = dropped + (do_insert & ~has_free).astype(U32)
-        return (widx, wleaf, wval, carry, dropped), out
-
-    (widx, wleaf, wval, carry, insert_dropped), outs = jax.lax.scan(
+    (em_set, em_alive, em_val, carry), outs = jax.lax.scan(
         step,
-        (widx, wleaf, wval, carry, jnp.zeros((), U32)),
-        (idxs, new_leaves, operands),
+        (
+            jnp.zeros((b,), jnp.bool_),
+            jnp.zeros((b,), jnp.bool_),
+            jnp.zeros((b, v), U32),
+            carry,
+        ),
+        (slot_iota, idxs, chain_slot, operands),
     )
+
+    # --- final per-key state → working-set rows ------------------------
+    # the round's last op on each key commits the chain result
+    final_alive = em_alive[chain_slot] & em_set[chain_slot]
+    final_val = em_val[chain_slot]
+    upd = last_occ & present0  # rewrite (or kill) the existing row
+    ins = last_occ & ~present0 & final_alive  # net insert → reserved row j
+
+    row_tgt = jnp.where(upd, pos0, U32(w))  # OOB = no write
+    widx = widx0.at[row_tgt].set(
+        jnp.where(final_alive, idxs, SENTINEL), mode="drop"
+    )
+    wval = wval0.at[row_tgt.astype(jnp.int32)].set(final_val, mode="drop")
+
+    widx = jnp.concatenate([widx, jnp.where(ins, idxs, SENTINEL)])
+    wval = jnp.concatenate([wval, final_val], axis=0)
+    insert_dropped = jnp.zeros((), U32)  # reserved rows: inserts never drop
+
+    # leaves for the whole working set come from the remapped private
+    # posmap (the authoritative assignment — the tree stores no leaves):
+    # rows touched this round already read back their op's new leaf
+    wleaf = working_leaves(posmap, cfg, widx)
 
     # --- 3. joint level-synchronous greedy eviction --------------------
     valid = widx != SENTINEL
@@ -193,7 +234,6 @@ def oram_round(
         placed = placed | chosen
 
     new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(widx, mode="drop")
-    new_pleaf = jnp.zeros((nslots,), U32).at[slot_tgt].set(wleaf, mode="drop")
     new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(wval, mode="drop")
 
     # --- 4. stash recompaction + write-back ----------------------------
@@ -201,23 +241,21 @@ def oram_round(
     srank = rank_of(leftover)
     starget = jnp.where(leftover, srank, s)  # OOB = dropped
     stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(widx, mode="drop")
-    stash_leaf = jnp.zeros((s,), U32).at[starget].set(wleaf, mode="drop")
     stash_val = jnp.zeros((s, v), U32).at[starget].set(wval, mode="drop")
     n_left = jnp.sum(leftover.astype(jnp.int32))
     stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
 
+    # owner expansion for the flat slot axis: each of a bucket's z slots
+    # shares the bucket's owner bit
+    fowner_slots = jnp.repeat(fowner, z)
     new_state = OramState(
         tree_idx=_path_scatter(
-            state.tree_idx, flat_b, new_pidx.reshape(b * plen, z), axis_name, fowner
-        ),
-        tree_leaf=_path_scatter(
-            state.tree_leaf, flat_b, new_pleaf.reshape(b * plen, z), axis_name, fowner
+            state.tree_idx, slot_b, new_pidx, axis_name, fowner_slots
         ),
         tree_val=_path_scatter(
-            state.tree_val, flat_b, new_pval.reshape(b * plen, z, v), axis_name, fowner
+            state.tree_val, flat_b, new_pval.reshape(b * plen, z * v), axis_name, fowner
         ),
         stash_idx=stash_idx,
-        stash_leaf=stash_leaf,
         stash_val=stash_val,
         posmap=posmap,
         overflow=state.overflow + stash_dropped + insert_dropped,

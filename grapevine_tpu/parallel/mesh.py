@@ -33,8 +33,8 @@ import functools
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..engine.round_step import engine_round_step
 from ..engine.state import EngineConfig, EngineState
-from ..engine.step import engine_step
 from ..oram.path_oram import OramState
 
 #: mesh axis across which the bucket trees are sharded
@@ -51,10 +51,8 @@ def make_mesh(devices=None) -> Mesh:
 def _oram_specs() -> OramState:
     return OramState(
         tree_idx=P(TREE_AXIS),
-        tree_leaf=P(TREE_AXIS),
         tree_val=P(TREE_AXIS),
         stash_idx=P(),
-        stash_leaf=P(),
         stash_val=P(),
         posmap=P(),
         overflow=P(),
@@ -90,13 +88,15 @@ def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     """Jit-compiled engine step with the bucket trees sharded over ``mesh``.
 
     The returned function has the same signature and semantics as
-    ``engine_step(ecfg, state, batch)`` (bit-identical results — tested in
-    tests/test_parallel.py, the analog of the reference's SGX_MODE=SW
-    simulation testing, reference .github/workflows/ci.yaml:15-16).
+    ``engine_round_step(ecfg, state, batch)`` — the phase-major batched
+    engine, i.e. the same commit schedule the single-chip production path
+    uses (bit-identical results — tested in tests/test_parallel.py, the
+    analog of the reference's SGX_MODE=SW simulation testing, reference
+    .github/workflows/ci.yaml:15-16).
     """
     specs = engine_state_specs()
     step = jax.shard_map(
-        functools.partial(engine_step, ecfg, axis_name=TREE_AXIS),
+        functools.partial(engine_round_step, ecfg, axis_name=TREE_AXIS),
         mesh=mesh,
         in_specs=(specs, P()),
         out_specs=(specs, P(), P()),

@@ -5,9 +5,26 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
-from ..config import GrapevineConfig
-from .service import GrapevineServer
+
+def _pin_platform() -> None:
+    """Honor JAX_PLATFORMS before any backend initializes.
+
+    Site hooks may pin a platform via ``jax.config`` (overriding the env
+    var), so an explicit request like ``JAX_PLATFORMS=cpu`` must be
+    re-asserted through the config API."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+_pin_platform()
+
+from ..config import GrapevineConfig  # noqa: E402
+from .service import GrapevineServer  # noqa: E402
 
 
 def build_parser() -> argparse.ArgumentParser:

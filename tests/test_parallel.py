@@ -12,7 +12,7 @@ import jax
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import pack_batch
 from grapevine_tpu.engine.state import EngineConfig, init_engine
-from grapevine_tpu.engine.step import engine_step
+from grapevine_tpu.engine.round_step import engine_round_step
 from grapevine_tpu.parallel import make_mesh, make_sharded_step, shard_engine_state
 from grapevine_tpu.wire import constants as C
 from grapevine_tpu.wire.records import QueryRequest, RequestRecord
@@ -50,7 +50,7 @@ def test_sharded_step_matches_single_chip():
     ecfg = EngineConfig.from_config(CFG)
 
     state = init_engine(ecfg, seed=3)
-    single = jax.jit(engine_step, static_argnums=(0,))
+    single = jax.jit(engine_round_step, static_argnums=(0,))
 
     mesh = make_mesh(jax.devices()[:8])
     sstate = shard_engine_state(init_engine(ecfg, seed=3), mesh)

@@ -137,13 +137,16 @@ def test_round_matches_sequential_oram():
 
 def test_occurrence_masks():
     idxs = jnp.asarray([3, 5, 3, 9, 5, 3, 7], U32)
-    first, last = occurrence_masks(idxs, dummy_index=9)  # 9 = dummy here
+    first, last, chain = occurrence_masks(idxs, dummy_index=9)  # 9 = dummy here
     np.testing.assert_array_equal(
         np.asarray(first), [True, True, False, False, False, False, True]
     )
     np.testing.assert_array_equal(
         np.asarray(last), [False, False, False, False, True, True, True]
     )
+    # [3,5,3,9,5,3,7]: same-key ops share the first occurrence's slot;
+    # the dummy (9) keeps its own
+    np.testing.assert_array_equal(np.asarray(chain), [0, 1, 0, 3, 1, 0, 6])
 
 
 # ---- phase-major engine vs oracle -------------------------------------
