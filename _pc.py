@@ -1,0 +1,51 @@
+import sys, time
+import numpy as np, jax, jax.numpy as jnp
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.state import EngineConfig, init_engine
+from grapevine_tpu.engine import vphases
+from grapevine_tpu.engine.state import mb_bucket_hash
+from grapevine_tpu.oram.round import oram_round
+from grapevine_tpu.oblivious.primitives import is_zero_words
+from bench import make_batches
+U32 = jnp.uint32
+
+bs = int(__import__("os").environ.get("BS","64"))
+cfg = GrapevineConfig(max_messages=1 << 16, max_recipients=1 << 12, batch_size=bs, stash_size=224)
+ecfg = EngineConfig.from_config(cfg)
+state = init_engine(ecfg, seed=0)
+batch = make_batches(1, bs)[0]
+rt = jnp.asarray(batch["req_type"], U32)
+is_create = rt == 1; is_read = rt == 2; is_update = rt == 3; is_delete = rt == 4
+is_real = is_create | is_read | is_update | is_delete
+msg_id = jnp.asarray(batch["msg_id"]); recipient = jnp.asarray(batch["recipient"])
+auth = jnp.asarray(batch["auth"]); payload = jnp.asarray(batch["payload"])
+id_zero = is_zero_words(msg_id); zero_recip = is_zero_words(recipient)
+ka = jnp.where((is_create | ~id_zero)[:, None], recipient, auth)
+bucket = jax.vmap(lambda k: mb_bucket_hash(state.hash_key, k, ecfg.mb_table_buckets))(ka)
+idxs_mb = jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index))
+ks = jnp.arange(bs, dtype=U32)
+cand_idx = state.freelist[jnp.where(ks < state.free_top, state.free_top - 1 - ks, 0)]
+ctx = dict(is_real=is_real, is_create=is_create, is_read=is_read, is_update=is_update,
+           is_delete=is_delete, id_zero=id_zero, zero_recip=zero_recip, ka=ka,
+           idxs_mb=idxs_mb, cand_idx=cand_idx,
+           id_rand=jnp.zeros((bs, 3), U32), free_top0=state.free_top,
+           recipients0=state.recipients, seq0=state.seq, now=jnp.uint32(1),
+           auth=auth, recipient=recipient, msg_id=msg_id, payload=payload)
+
+which = sys.argv[1]
+nl = jnp.zeros((bs,), U32); dl = jnp.ones((bs,), U32)
+
+if which == "a":
+    f = jax.jit(lambda st: oram_round(ecfg.mb, st, idxs_mb, nl, dl, vphases.phase_a_batch(ecfg, ctx)))
+    t0 = time.perf_counter(); f.lower(state.mb).compile(); print("A compiled", time.perf_counter()-t0)
+elif which == "b":
+    ctx_b = {**ctx, "idx_b": jnp.where(is_real, ks % U32(ecfg.rec.leaves), U32(ecfg.rec.dummy_index)),
+             "real_b": is_real, "create_ok": is_create, "new_id": jnp.zeros((bs,4),U32),
+             "sel_blk": jnp.zeros((bs,),U32), "sel_idw": jnp.zeros((bs,),U32)}
+    nlb = jnp.zeros((bs,), U32)
+    f = jax.jit(lambda st: oram_round(ecfg.rec, st, ctx_b["idx_b"], nlb, nlb+1, vphases.phase_b_batch(ecfg, ctx_b)))
+    t0 = time.perf_counter(); f.lower(state.rec).compile(); print("B compiled", time.perf_counter()-t0)
+elif which == "c":
+    ctx_c = {**ctx, "del_ok": is_delete, "upd_ok": is_update, "rm_a": jnp.zeros((bs,), bool)}
+    f = jax.jit(lambda st: oram_round(ecfg.mb, st, idxs_mb, nl, dl, vphases.phase_c_batch(ecfg, ctx_c)))
+    t0 = time.perf_counter(); f.lower(state.mb).compile(); print("C compiled", time.perf_counter()-t0)

@@ -1,0 +1,36 @@
+import sys, time
+from functools import partial
+import numpy as np, jax, jax.numpy as jnp
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.state import EngineConfig, init_engine
+from grapevine_tpu.engine.round_step import engine_round_step
+from bench import make_batches
+
+cap, bs, reps = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+cfg = GrapevineConfig(max_messages=cap, max_recipients=1 << 12,
+                      batch_size=bs, stash_size=max(224, bs // 2 + 96))
+ecfg = EngineConfig.from_config(cfg)
+state = init_engine(ecfg, seed=0)
+raw = make_batches(8, bs)
+stacked = {k: jnp.stack([jnp.asarray(b[k]) for b in raw]) for k in raw[0]}
+
+@partial(jax.jit, static_argnums=(2,))
+def many_rounds(state, stacked, reps):
+    def outer(st, _):
+        def body(st, batch):
+            st, resp, _ = engine_round_step(ecfg, st, batch)
+            return st, resp["status"].sum()
+        st, s = jax.lax.scan(body, st, stacked)
+        return st, s.sum()
+    state, sums = jax.lax.scan(outer, state, None, length=reps)
+    return state, sums.sum() + state.rec.tree_val.sum() + state.mb.tree_val.sum()
+
+st2, c = many_rounds(state, stacked, reps)
+_ = int(np.asarray(c))  # compile + settle
+t0 = time.perf_counter()
+st2, c = many_rounds(state, stacked, reps)
+cval = int(np.asarray(c))
+dt = time.perf_counter() - t0
+rounds = 8 * reps
+ov = int(np.asarray(st2.rec.overflow)) + int(np.asarray(st2.mb.overflow))
+print(f"cap=2^{cap.bit_length()-1} bs={bs}: {dt/rounds*1e3:.3f} ms/round, {bs*rounds/dt:,.0f} ops/s, ov={ov}")

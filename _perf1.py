@@ -1,0 +1,23 @@
+import sys, time
+import numpy as np, jax, jax.numpy as jnp
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.state import EngineConfig, init_engine
+from grapevine_tpu.engine.round_step import engine_round_step
+from bench import make_batches
+
+cap, bs = int(sys.argv[1]), int(sys.argv[2])
+cfg = GrapevineConfig(max_messages=cap, max_recipients=1 << 12,
+                      batch_size=bs, stash_size=max(224, bs // 2 + 96))
+ecfg = EngineConfig.from_config(cfg)
+state = init_engine(ecfg, seed=0)
+step = jax.jit(engine_round_step, static_argnums=(0,), donate_argnums=(1,))
+batches = [jax.device_put(b) for b in make_batches(4, bs)]
+t0 = time.perf_counter()
+state, resp, _ = step(ecfg, state, batches[0])
+s0 = int(np.asarray(resp["status"]).sum())
+print(f"compile+first: {time.perf_counter()-t0:.1f}s, statuses {s0}")
+for i in range(6):
+    t0 = time.perf_counter()
+    state, resp, _ = step(ecfg, state, batches[(i+1) % 4])
+    _ = int(np.asarray(resp["status"]).sum()) + int(np.asarray(state.rec.overflow))
+    print(f"round: {(time.perf_counter()-t0)*1e3:.2f} ms (hard-synced)")

@@ -1,12 +1,13 @@
-"""Phase-major batched engine step: three ORAM rounds per batch.
+"""Phase-major batched engine step: three vectorized ORAM rounds per batch.
 
 `engine/step.py` commits each op's three phases before the next op starts
 (op-major), which serializes 3·B dependent path fetches. This module runs
 the same three phases *phase-major* over the batched round primitive
 (oram/round.py): one mailbox round applying phase A for every op in slot
 order, one records round applying phase B, one mailbox round applying
-phase C. The semantic phase functions are shared with the op-major engine
-verbatim — only the commit schedule differs.
+phase C. Within each round the slot-order semantics are resolved fully in
+parallel (engine/vphases.py) — there is no per-op loop anywhere on the
+device hot path.
 
 **Phase-major commit semantics** (the documented batch-hazard behavior of
 this engine; the reference never faced batches, SURVEY.md §7.6). Within
@@ -32,7 +33,9 @@ identical (no cross-op window), which tests assert.
 Obliviousness: the public transcript is one uniform leaf per op per
 round, [mailbox, records, mailbox] — identical in distribution for every
 op type including padding dummies; duplicate-index dedup inside
-oram_round keeps same-key ops uncorrelated in the transcript.
+oram_round keeps same-key ops uncorrelated in the transcript. Quota
+admission may branch on *aggregate* saturation (bus or recipient table
+within B of full) — see the leak analysis in engine/vphases.py.
 """
 
 from __future__ import annotations
@@ -45,7 +48,7 @@ from ..wire import constants as C
 from ..oram.round import oram_round
 from .responses import assemble_responses
 from .state import EngineConfig, EngineState, mb_bucket_hash
-from .step import _phase_a, _phase_b, _phase_c
+from .vphases import phase_a_batch, phase_b_batch, phase_c_batch
 
 U32 = jnp.uint32
 
@@ -97,61 +100,41 @@ def engine_round_step(
     )(ka)
     idxs_mb = jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index))
 
-    # ---- round A: mailbox (capacity, append, zero-id select/pop) ------
-    # Freelist discipline: the big freelist array never enters a scan
-    # carry (a mode="drop" scatter on a capacity-sized array inside a
-    # scan body stalls every iteration on a fresh copy — profiled at
-    # ~25 ms/round at 2^20). Instead the top B candidate blocks are
-    # pre-gathered here; the scan only advances a counter; frees are
-    # pushed back in one vectorized scatter after round B.
+    # allocation candidates: the top B free blocks, pre-gathered so the
+    # freelist array never enters device decision logic (vphases assigns
+    # the n-th successful create candidate n)
     ks = jnp.arange(b, dtype=U32)
     cand_pos = jnp.where(ks < state.free_top, state.free_top - U32(1) - ks, 0)
     cand_idx = state.freelist[cand_pos]
 
-    opnd_a = {
-        "ka": ka,
-        "idr": id_rand,
-        "is_create": is_create & is_real,
+    # ---- round A: mailbox (capacity, append, zero-id select/pop) ------
+    ctx = {
+        "is_real": is_real,
+        "is_create": is_create,
+        "is_read": is_read,
+        "is_update": is_update,
         "is_delete": is_delete,
         "id_zero": id_zero,
         "zero_recip": zero_recip,
+        "ka": ka,
+        "idxs_mb": idxs_mb,
+        "cand_idx": cand_idx,
+        "id_rand": id_rand,
+        "free_top0": state.free_top,
+        "recipients0": state.recipients,
+        "seq0": state.seq,
+        "now": now,
+        "auth": auth,
+        "recipient": recipient,
+        "msg_id": msg_id,
+        "payload": payload,
     }
-
-    def apply_a(carry, value, present, o):
-        n_alloc, recipients, seq = carry
-        can_alloc = n_alloc < state.free_top
-        alloc_idx = cand_idx[jnp.minimum(n_alloc, U32(b - 1))]
-        new_id = jnp.stack(
-            [alloc_idx, o["idr"][0] | U32(1), o["idr"][1], o["idr"][2]]
-        )
-        oo = {
-            **o,
-            "can_alloc": can_alloc,
-            "alloc_idx": alloc_idx,
-            "new_id": new_id,
-            "recipients": recipients,
-            "seq": seq,
-            "now": now,
-        }
-        new_value, keep, insert, out = _phase_a(ecfg, value, present, oo)
-        out = {**out, "alloc_idx": alloc_idx, "new_id": new_id}
-        n_alloc = n_alloc + out["create_ok"].astype(U32)
-        recipients = (recipients.astype(jnp.int32) + out["recip_delta"]).astype(U32)
-        seq = seq + out["create_ok"].astype(U32)
-        return (n_alloc, recipients, seq), new_value, keep, insert, out
-
-    mb1, (n_alloc, recipients, seq), out_a, leaf_a = oram_round(
-        ecfg.mb,
-        state.mb,
-        idxs_mb,
-        nl_a,
-        dl_a,
-        opnd_a,
-        apply_a,
-        (jnp.zeros((), U32), state.recipients, state.seq),
-        axis_name,
+    mb1, out_a, leaf_a = oram_round(
+        ecfg.mb, state.mb, idxs_mb, nl_a, dl_a, phase_a_batch(ecfg, ctx), axis_name
     )
-    free_top = state.free_top - n_alloc
+    free_top = state.free_top - out_a["n_allocs"]
+    recipients = state.recipients + out_a["n_claims"]
+    seq = state.seq + U32(b)
 
     # ---- round B: records (verify, insert, mutate, remove) ------------
     create_ok = out_a["create_ok"]
@@ -166,40 +149,21 @@ def engine_round_step(
     idx_b = jnp.where(
         real_b, lookup_blk & U32(ecfg.rec.leaves - 1), U32(ecfg.rec.dummy_index)
     )
-    opnd_b = {
-        "sel_blk": out_a["sel_blk"],
-        "sel_idw": out_a["sel_idw"],
-        "msg_id": msg_id,
-        "id_zero": id_zero,
-        "is_create": is_create & is_real,
-        "is_read": is_read,
-        "is_update": is_update,
-        "is_delete": is_delete,
-        "auth": auth,
-        "recipient": recipient,
-        "payload": payload,
+    ctx_b = {
+        **ctx,
+        "idx_b": idx_b,
+        "real_b": real_b,
         "create_ok": create_ok,
         "new_id": out_a["new_id"],
+        "sel_blk": out_a["sel_blk"],
+        "sel_idw": out_a["sel_idw"],
     }
-
-    def apply_b(carry, value, present, o):
-        new_value, keep, insert, out = _phase_b(ecfg, value, present, {**o, "now": now})
-        return carry, new_value, keep, insert, out
-
-    rec1, _, out_b, leaf_b = oram_round(
-        ecfg.rec,
-        state.rec,
-        idx_b,
-        nl_b,
-        dl_b,
-        opnd_b,
-        apply_b,
-        jnp.zeros((), U32),
-        axis_name,
+    rec1, out_b, leaf_b = oram_round(
+        ecfg.rec, state.rec, idx_b, nl_b, dl_b, phase_b_batch(ecfg, ctx_b), axis_name
     )
 
     # freed blocks return to the freelist in slot order — one vectorized
-    # scatter, visible only to the next batch (round_step commit schedule)
+    # scatter, visible only to the next batch (phase-major commit rule)
     dels = out_b["del_ok"]
     push_pos = jnp.where(
         dels, free_top + rank_of(dels).astype(U32), U32(ecfg.max_messages)
@@ -208,21 +172,14 @@ def engine_round_step(
     free_top = free_top + jnp.sum(dels.astype(U32))
 
     # ---- round C: mailbox finalization --------------------------------
-    opnd_c = {
-        "ka": ka,
-        "msg_id": msg_id,
+    ctx_c = {
+        **ctx,
         "del_ok": out_b["del_ok"],
         "upd_ok": out_b["upd_ok"],
         "rm_a": out_a["rm_a"],
     }
-
-    def apply_c(carry, value, present, o):
-        new_value, keep, insert, out = _phase_c(ecfg, value, present, {**o, "now": now})
-        recipients = (carry.astype(jnp.int32) + out["recip_delta"]).astype(U32)
-        return recipients, new_value, keep, insert, out
-
-    mb2, recipients, _out_c, leaf_c = oram_round(
-        ecfg.mb, mb1, idxs_mb, nl_c, dl_c, opnd_c, apply_c, recipients, axis_name
+    mb2, _out_c, leaf_c = oram_round(
+        ecfg.mb, mb1, idxs_mb, nl_c, dl_c, phase_c_batch(ecfg, ctx_c), axis_name
     )
 
     # ---- response assembly (shared with the op-major engine) ----------

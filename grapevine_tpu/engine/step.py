@@ -131,19 +131,17 @@ def _phase_a(ecfg: EngineConfig, value, present, o):
     ent_mod = jnp.where(append_oh[:, None], new_entry[None, :], tgt_entries)
     ent_mod = jnp.where((rm_oh & rm_a)[:, None], jnp.zeros((4,), U32)[None, :], ent_mod)
 
-    count_after = count + create_ok.astype(jnp.int32) - rm_a.astype(jnp.int32)
-    clear_key = rm_a & (count_after == 0)
+    # sticky mailbox slots: a drained mailbox keeps its key slot until
+    # the expiry sweep reclaims it (see engine/vphases.py docstring)
     new_key = jnp.where(
-        create_ok & ~found,
-        o["ka"],
-        jnp.where(clear_key, jnp.zeros_like(o["ka"]), onehot_select(tgt_oh, keys)),
+        create_ok & ~found, o["ka"], onehot_select(tgt_oh, keys)
     )
 
     keys_out = jnp.where(tgt_oh[:, None], new_key[None, :], keys)
     entries_out = jnp.where(tgt_oh[:, None, None], ent_mod[None, :, :], entries)
 
-    recip_delta = (create_ok & ~found).astype(jnp.int32) - clear_key.astype(jnp.int32)
-    keep = jnp.any(~is_zero_words(keys_out))
+    recip_delta = (create_ok & ~found).astype(jnp.int32)
+    keep = jnp.bool_(True)  # sticky: mailbox blocks persist until sweep
     insert = create_ok & ~present
 
     out = {
@@ -235,20 +233,13 @@ def _phase_c(ecfg: EngineConfig, value, present, o):
         ent_mod,
     )
 
-    removed = jnp.any(ent_match & rm_c)
-    count_after = jnp.sum((ent_mod[:, ENT_SEQ] != 0).astype(jnp.int32))
-    clear_key = removed & (count_after == 0)
-    new_key = jnp.where(
-        clear_key, jnp.zeros_like(o["ka"]), onehot_select(slot_match, keys)
-    )
-
-    keys_out = jnp.where(slot_match[:, None], new_key[None, :], keys)
+    # sticky mailbox slots: never clear keys here (sweep reclaims)
     entries_out = jnp.where(slot_match[:, None, None], ent_mod[None, :, :], entries)
 
-    recip_delta = -clear_key.astype(jnp.int32)
-    keep = jnp.any(~is_zero_words(keys_out))
+    recip_delta = jnp.zeros((), jnp.int32)
+    keep = jnp.bool_(True)
     out = {"recip_delta": recip_delta}
-    return mb_pack(ecfg, keys_out, entries_out), keep, jnp.bool_(False), out
+    return mb_pack(ecfg, keys, entries_out), keep, jnp.bool_(False), out
 
 
 def engine_step(

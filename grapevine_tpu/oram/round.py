@@ -16,24 +16,23 @@ PAPERS.md and SURVEY.md §7 "hard parts" 6):
    fetched in one gather; buckets shared by several paths (always true
    near the root) are attributed to a single *owner* path slot and
    invalidated elsewhere, so each live block enters the working set once.
-2. **Apply**: ops are applied in slot order (the documented within-batch
-   commit order, SURVEY.md §7.6) under a `lax.scan`, but the scan never
-   carries the W-row working set — that would spill VMEM at large
-   batches (measured: a 68× working-set carry collapses throughput ~35×
-   past the VMEM limit). Instead each op's *initial* match row is
-   precomputed with one static [B, W] compare + one B-row gather, and
-   within-round read-after-write is resolved through a B-slot *chain
-   buffer*: ops on the same logical key share the slot of the key's
-   first occurrence, each op reads its chain slot (latest value + alive
-   bit) and writes back its result. The scan carry is O(B·V), fully
-   VMEM-resident at any sane batch. The chain-slot row gather is a
-   secret-position access into *private working memory* — the same
-   standing the flat position map already has (see the threat model in
-   path_oram.py): obliviousness is claimed for the HBM bucket-tree
-   transcript, and the working set, like the stash and position map, is
-   EPC-analog private state. After the scan, each key's final
-   (value, alive, leaf) is scattered back to its working-set row — net
-   inserts go to B reserved rows — and eviction proceeds as before.
+2. **Apply**: slot-order semantics (the documented within-batch commit
+   order, SURVEY.md §7.6) are resolved by a fully **vectorized** batch
+   callback — there is NO per-op `lax.scan` anywhere in the round. A
+   sequential scan body costs ~30-130µs *per iteration* on TPU (profiled;
+   it dominated the entire framework), so within-round read-after-write
+   chains are instead computed in parallel: the round hands the callback
+   each op's *initial* row value + presence (one static [B, W] compare +
+   one B-row gather), and the callback resolves same-key chains with
+   same-key matrices / segmented scans (see engine/vphases.py and
+   oblivious/segmented.py) and returns each op's outputs plus the final
+   per-key committed state. The [B, W] compare and row gathers are
+   private-working-memory accesses — the same standing the flat position
+   map already has (see the threat model in path_oram.py): obliviousness
+   is claimed for the HBM bucket-tree transcript; the working set, like
+   the stash and position map, is EPC-analog private state. The final
+   (value, alive) of each key is scattered back to its working-set row —
+   net inserts go to B reserved rows — and eviction proceeds.
 3. **Evict**: one level-synchronous greedy pass assigns every working-set
    entry to the deepest fetched bucket on its own path, jointly across
    all B paths (an entry's path meets each level in exactly one bucket,
@@ -42,12 +41,8 @@ PAPERS.md and SURVEY.md §7 "hard parts" 6):
    read transcript).
 
 Net effect per round: 2 large HBM transfers (gather + scatter) per tree
-array instead of 2·B small dependent ones, and the only remaining
-sequential chain is the cheap apply scan.
-
-Semantics note: `apply_fn` threads an engine carry through the ops, which
-is what lets the query engine keep its capacity counters sequentially
-consistent inside a round (engine/round_step.py).
+array instead of 2·B small dependent ones, with all decision logic in
+O(log B)-depth parallel form.
 """
 
 from __future__ import annotations
@@ -108,20 +103,26 @@ def oram_round(
     idxs: jax.Array,  # u32[B] block indices (cfg.dummy_index = dummy op)
     new_leaves: jax.Array,  # u32[B] fresh uniform leaves (remap targets)
     dummy_leaves: jax.Array,  # u32[B] fresh uniform leaves (dummy fetches)
-    operands,  # pytree, leading batch axis
-    apply_fn,
-    carry,
+    apply_batch,
     axis_name: str | None = None,
 ):
     """One batched oblivious access round over this ORAM.
 
-    ``apply_fn(carry, value u32[V], present bool, operand) ->
-    (carry, new_value u32[V], keep bool, insert bool, out pytree)`` with
-    the same branchless contract as `oram_access`'s ``fn``, plus the
-    threaded engine carry.
+    ``apply_batch(vals0 u32[B,V], present0 bool[B]) ->
+    (outs pytree, final_val u32[B,V], final_alive bool[B])``:
 
-    Returns ``(state', carry, outs, leaves)``; ``leaves`` u32[B] is the
-    public transcript (every entry an independent uniform draw).
+    - ``vals0[j]``/``present0[j]``: the pre-round value (zeros if absent)
+      and presence of op j's key in the working set;
+    - the callback resolves within-round slot-order chain semantics
+      itself, **vectorized** (same-key matrices / segmented scans; it
+      knows which ops share keys — typically via `occurrence_masks` on
+      the same ``idxs``);
+    - ``final_val[j]`` / ``final_alive[j]``: the key's state after the
+      whole round. Only the values at each key's *last* occurrence are
+      committed; the callback must put the final state there.
+
+    Returns ``(state', outs, leaves)``; ``leaves`` u32[B] is the public
+    transcript (every entry an independent uniform draw).
     """
     b = idxs.shape[0]
     z, v, plen, h = cfg.bucket_slots, cfg.value_words, cfg.path_len, cfg.height
@@ -129,7 +130,7 @@ def oram_round(
     nslots = b * plen * z
 
     # --- 1. dedup, position-map read/remap, path fetch -----------------
-    first_occ, last_occ, chain_slot = occurrence_masks(idxs, cfg.dummy_index)
+    first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
     # last occurrence wins the remap; others retarget the throwaway
     # dummy-index slot (posmap[leaves] backs cfg.dummy_index)
@@ -150,51 +151,21 @@ def oram_round(
     wval0 = jnp.concatenate([state.stash_val, pval.reshape(-1, v)], axis=0)
     w = s + nslots + b  # + b reserved rows for net inserts
 
-    # --- 2. slot-order apply via the B-slot chain buffer ---------------
+    # --- 2. vectorized slot-order apply --------------------------------
     # Initial presence: one static [B, W] compare against the (immutable
     # during apply) working set + one B-row gather. Block indices are
     # unique among live blocks, so each op matches at most one row.
     match0 = (widx0[None, :] == idxs[:, None]) & (widx0 != SENTINEL)[None, :]
     present0 = jnp.any(match0, axis=1)  # bool[B]
     pos0 = jnp.argmax(match0, axis=1).astype(U32)  # u32[B]; 0 when absent
-    vals0 = wval0[pos0.astype(jnp.int32)]  # u32[B, V]
+    vals0 = jnp.where(
+        present0[:, None], wval0[pos0.astype(jnp.int32)], 0
+    )  # u32[B, V]
 
-    slot_iota = jnp.arange(b, dtype=U32)
-
-    def step(sc, xs):
-        em_set, em_alive, em_val, carry = sc
-        j, idx, cslot, opnd = xs
-        chained = em_set[cslot]
-        chain_val = em_val[cslot]
-        chain_alive = em_alive[cslot]
-        present = jnp.where(chained, chain_alive, present0[j])
-        raw = jnp.where(chained, chain_val, vals0[j])
-        value = jnp.where(present, raw, jnp.zeros_like(raw))
-
-        carry, new_value, keep, insert, out = apply_fn(carry, value, present, opnd)
-
-        real = idx != U32(cfg.dummy_index)
-        alive = jnp.where(present, keep, insert & real)
-        em_set = em_set.at[cslot].set(em_set[cslot] | real)
-        em_alive = em_alive.at[cslot].set(alive)
-        em_val = em_val.at[cslot].set(jnp.where(present | insert, new_value, raw))
-        return (em_set, em_alive, em_val, carry), out
-
-    (em_set, em_alive, em_val, carry), outs = jax.lax.scan(
-        step,
-        (
-            jnp.zeros((b,), jnp.bool_),
-            jnp.zeros((b,), jnp.bool_),
-            jnp.zeros((b, v), U32),
-            carry,
-        ),
-        (slot_iota, idxs, chain_slot, operands),
-    )
+    outs, final_val, final_alive = apply_batch(vals0, present0)
 
     # --- final per-key state → working-set rows ------------------------
-    # the round's last op on each key commits the chain result
-    final_alive = em_alive[chain_slot] & em_set[chain_slot]
-    final_val = em_val[chain_slot]
+    # the round's last op on each key commits the callback's final state
     upd = last_occ & present0  # rewrite (or kill) the existing row
     ins = last_occ & ~present0 & final_alive  # net insert → reserved row j
 
@@ -206,7 +177,6 @@ def oram_round(
 
     widx = jnp.concatenate([widx, jnp.where(ins, idxs, SENTINEL)])
     wval = jnp.concatenate([wval, final_val], axis=0)
-    insert_dropped = jnp.zeros((), U32)  # reserved rows: inserts never drop
 
     # leaves for the whole working set come from the remapped private
     # posmap (the authoritative assignment — the tree stores no leaves):
@@ -258,6 +228,6 @@ def oram_round(
         stash_idx=stash_idx,
         stash_val=stash_val,
         posmap=posmap,
-        overflow=state.overflow + stash_dropped + insert_dropped,
+        overflow=state.overflow + stash_dropped,
     )
-    return new_state, carry, outs, leaves
+    return new_state, outs, leaves

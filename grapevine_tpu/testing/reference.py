@@ -94,8 +94,8 @@ class ReferenceEngine:
         if box is None:
             return
         box[:] = [m for m in box if m != msg_id]
-        if not box:
-            del self.mailboxes[recipient]
+        # sticky slots: a drained mailbox keeps its recipient slot until
+        # the expiry sweep reclaims it (engine/vphases.py docstring)
 
     @staticmethod
     def _ok(rec: Record) -> QueryResponse:
@@ -349,6 +349,8 @@ class ReferenceEngine:
         for mid in dead:
             rec = self.records.pop(mid)
             self._remove_mailbox_entry(rec.recipient, mid)
+        # the sweep is the one place drained mailboxes release their slot
+        self.mailboxes = {r: box for r, box in self.mailboxes.items() if box}
         return len(dead)
 
     # -- introspection for tests ---------------------------------------

@@ -45,9 +45,42 @@ def kv_fn(value, present, opnd):
     return new_value, keep, insert, out
 
 
-def kv_apply(carry, value, present, opnd):
-    nv, k, i, out = kv_fn(value, present, opnd)
-    return carry, nv, k, i, out
+def kv_apply_batch(cfg, idxs, codes, vals):
+    """Vectorized slot-order chain semantics for the simple KV ops —
+    the test-side model of the engine's vphases approach: the last
+    state-changing op (write/delete) before each op defines its view."""
+
+    def apply_batch(vals0, present0):
+        b = idxs.shape[0]
+        real = idxs != U32(cfg.dummy_index)
+        eq = (idxs[:, None] == idxs[None, :]) & real[:, None] & real[None, :]
+        tril_s = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)
+        iota = jnp.arange(b, dtype=jnp.int32)
+        is_w = (codes == OP_WRITE) & real
+        is_d = (codes == OP_DELETE) & real
+        ch = eq & (is_w | is_d)[None, :]
+
+        def state_at(mask):
+            lj = jnp.max(jnp.where(mask, iota[None, :], -1), axis=1)
+            has = lj >= 0
+            ljc = jnp.clip(lj, 0, b - 1)
+            alive = jnp.where(has, is_w[ljc], present0 & real)
+            value = jnp.where(
+                (has & is_w[ljc])[:, None],
+                vals[ljc],
+                jnp.where(present0[:, None], vals0, 0),
+            )
+            return alive, value
+
+        present_i, value_i = state_at(ch & tril_s)  # state before each op
+        out = {
+            "present": present_i,
+            "value": jnp.where(present_i[:, None], value_i, 0),
+        }
+        final_alive, final_val = state_at(ch)  # state after the round
+        return out, final_val, final_alive
+
+    return apply_batch
 
 
 def _random_kv_batches(cfg, n_batches, batch, seed):
@@ -93,11 +126,13 @@ def test_round_matches_sequential_oram():
         lambda st, idxs, nl, ops: oram_access_batch(cfg, st, idxs, nl, ops, kv_fn),
         static_argnums=(),
     )
-    rnd_step = jax.jit(
-        lambda st, idxs, nl, dl, ops: oram_round(
-            cfg, st, idxs, nl, dl, ops, kv_apply, jnp.zeros((), U32)
+
+    def rnd_fn(st, idxs, nl, dl, codes, vals):
+        return oram_round(
+            cfg, st, idxs, nl, dl, kv_apply_batch(cfg, idxs, codes, vals)
         )
-    )
+
+    rnd_step = jax.jit(rnd_fn)
 
     rkey = jax.random.PRNGKey(42)
     for bi, (idxs, codes, vals) in enumerate(_random_kv_batches(cfg, 8, batch, 7)):
@@ -107,7 +142,9 @@ def test_round_matches_sequential_oram():
         dl = jax.random.bits(k3, (batch,), U32) & U32(cfg.leaves - 1)
         ops = (jnp.asarray(codes), jnp.asarray(vals))
         st_seq, out_s, _ = seq_step(st_seq, jnp.asarray(idxs), nl1, ops)
-        st_rnd, _, out_r, leaves = rnd_step(st_rnd, jnp.asarray(idxs), nl2, dl, ops)
+        st_rnd, out_r, leaves = rnd_step(
+            st_rnd, jnp.asarray(idxs), nl2, dl, jnp.asarray(codes), jnp.asarray(vals)
+        )
         np.testing.assert_array_equal(
             np.asarray(out_s["present"]), np.asarray(out_r["present"]), f"batch {bi}"
         )
