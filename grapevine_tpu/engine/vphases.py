@@ -382,8 +382,12 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         claim_slot_oh = (
             (~key_valid0) & (free_rank == claim_rank[:, None]) & claim_ok[:, None]
         )  # [B,K]
-        # my recipient's key slot (original or claimed at first-create op)
-        claim_slot_r = claim_slot_oh[rslot.astype(jnp.int32)]  # [B,K]
+        # my recipient's key slot (original or claimed). The claim lives
+        # at the group's first-*create* op, which need not be the group's
+        # first op (a zero-id R/D by the same recipient may precede it in
+        # slot order), so OR-aggregate over the whole group — at most one
+        # op per group has claim_ok.
+        claim_slot_r = _bool_matmul(requal, claim_slot_oh)  # [B,K]
         mslot_oh = jnp.where(found0[:, None], slot_match0, claim_slot_r)
         mslot_idx = jnp.argmax(mslot_oh, axis=1).astype(U32)
         has_mslot = jnp.any(mslot_oh, axis=1)

@@ -1,0 +1,176 @@
+"""Directed same-key op-ordering tests for the phase-major engine.
+
+Round-2 verdict reproduced a silent message loss: in one batch, a
+zero-id op by recipient X *before* the first CREATE→X (with X's mailbox
+block absent) made the create return SUCCESS and insert the record, but
+never appended the mailbox entry — the claimed key slot was gathered
+from the group's *first op* instead of its first-*create* op
+(engine/vphases.py). The randomized suites rarely generate that
+ordering, so this file enumerates same-key op-order permutations
+directly, on absent and present mailboxes, and checks the engine against
+the oracle plus a follow-up drain.
+
+Reference semantics: zero-id ops (grapevine.proto:87-91,115-118);
+within-batch slot order is this build's documented extension
+(engine/round_step.py).
+"""
+
+import itertools
+import random
+
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.testing.reference import ReferenceEngine
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+CFG = GrapevineConfig(
+    max_messages=64,
+    max_recipients=8,
+    mailbox_cap=4,
+    batch_size=8,
+    stash_size=96,
+)
+
+
+def key(n: int) -> bytes:
+    return bytes([n, n ^ 0x5A]) + b"\x01" * 30
+
+
+def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, pl=None, tag=0):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id,
+            recipient=recipient,
+            payload=pl if pl is not None else bytes([tag & 0xFF]) * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def assert_responses_equal(dev, ora, ctx=""):
+    assert dev.status_code == ora.status_code, (
+        f"{ctx}: status {dev.status_code} != {ora.status_code}"
+    )
+    assert dev.record.msg_id == ora.record.msg_id, f"{ctx}: id"
+    assert dev.record.sender == ora.record.sender, f"{ctx}: sender"
+    assert dev.record.recipient == ora.record.recipient, f"{ctx}: recipient"
+    assert dev.record.payload == ora.record.payload, f"{ctx}: payload"
+    assert dev.record.timestamp == ora.record.timestamp, f"{ctx}: ts"
+
+
+def run_pair(engine, oracle, reqs, t):
+    """One batch through engine and oracle (forced ids), compare all."""
+    dev = engine.handle_queries(reqs, t)
+    forced = [
+        d.record.msg_id
+        if r.request_type == C.REQUEST_TYPE_CREATE
+        and d.status_code == C.STATUS_CODE_SUCCESS
+        else None
+        for r, d in zip(reqs, dev)
+    ]
+    ora = oracle.handle_batch(reqs, t, forced)
+    for j, (r, d, o) in enumerate(zip(reqs, dev, ora)):
+        assert_responses_equal(d, o, f"slot {j} rt {r.request_type}")
+    assert engine.message_count() == oracle.message_count()
+    assert engine.recipient_count() == oracle.recipient_count()
+    return dev, ora
+
+
+def test_zero_read_before_create_on_absent_mailbox():
+    """The round-2 verdict reproduction: batch [zero-id READ by X,
+    CREATE→X] on a fresh engine, then a follow-up zero-id READ by X must
+    return SUCCESS with the created record (not NOT_FOUND)."""
+    engine = GrapevineEngine(CFG, seed=3)
+    oracle = ReferenceEngine(config=CFG, rng=random.Random(99))
+    x, s = key(1), key(2)
+
+    batch = [
+        req(C.REQUEST_TYPE_READ, x),  # zero-id: "next message for X"
+        req(C.REQUEST_TYPE_CREATE, s, recipient=x, tag=7),
+    ]
+    dev, _ = run_pair(engine, oracle, batch, NOW)
+    assert dev[1].status_code == C.STATUS_CODE_SUCCESS
+
+    follow, _ = run_pair(engine, oracle, [req(C.REQUEST_TYPE_READ, x)], NOW + 1)
+    assert follow[0].status_code == C.STATUS_CODE_SUCCESS
+    assert follow[0].record.msg_id == dev[1].record.msg_id
+    assert follow[0].record.payload == bytes([7]) * C.PAYLOAD_SIZE
+
+
+def _ops_for(kind, x, s, tag):
+    """An op on recipient-X's mailbox group, by kind tag."""
+    if kind == "create":
+        return req(C.REQUEST_TYPE_CREATE, s, recipient=x, tag=tag)
+    if kind == "zread":
+        return req(C.REQUEST_TYPE_READ, x)
+    if kind == "zdel":
+        return req(C.REQUEST_TYPE_DELETE, x)
+    raise ValueError(kind)
+
+
+@pytest.mark.parametrize("preexisting", [0, 1, 2])
+@pytest.mark.parametrize(
+    "perm",
+    list(itertools.permutations(["zread", "create", "zdel"]))
+    + [
+        ("zread", "create"),
+        ("zdel", "create"),
+        ("zread", "zdel", "create", "create"),
+        ("zdel", "zread", "create", "zread"),
+        ("create", "zdel", "zread", "create"),
+    ],
+)
+def test_same_key_order_permutations(perm, preexisting):
+    """Every ordering of {zero-id read, zero-id delete, create} on one
+    recipient within a batch must match the oracle, with the mailbox
+    absent (preexisting=0) or present with 1-2 messages, and must leave
+    a drainable state (follow-up zero-id reads agree too)."""
+    engine = GrapevineEngine(CFG, seed=11)
+    oracle = ReferenceEngine(config=CFG, rng=random.Random(42))
+    x, s = key(1), key(2)
+
+    t = NOW
+    if preexisting:
+        setup = [
+            req(C.REQUEST_TYPE_CREATE, s, recipient=x, tag=100 + i)
+            for i in range(preexisting)
+        ]
+        run_pair(engine, oracle, setup, t)
+        t += 1
+
+    batch = [_ops_for(kind, x, s, 10 + i) for i, kind in enumerate(perm)]
+    run_pair(engine, oracle, batch, t)
+
+    # drain: the mailbox contents after the hazard batch must agree
+    for i in range(preexisting + len(perm) + 1):
+        t += 1
+        run_pair(engine, oracle, [req(C.REQUEST_TYPE_READ, x)], t)
+        run_pair(engine, oracle, [req(C.REQUEST_TYPE_DELETE, x)], t)
+
+
+def test_zero_ops_by_two_recipients_interleaved():
+    """Two recipient groups sharing a batch, each with a zero-id op
+    before its first create; neither group's claim may be lost."""
+    engine = GrapevineEngine(CFG, seed=5)
+    oracle = ReferenceEngine(config=CFG, rng=random.Random(7))
+    x, y, s = key(1), key(3), key(2)
+    batch = [
+        req(C.REQUEST_TYPE_READ, x),
+        req(C.REQUEST_TYPE_DELETE, y),
+        req(C.REQUEST_TYPE_CREATE, s, recipient=y, tag=1),
+        req(C.REQUEST_TYPE_CREATE, s, recipient=x, tag=2),
+    ]
+    run_pair(engine, oracle, batch, NOW)
+    for ident, tag in ((x, 2), (y, 1)):
+        resp, _ = run_pair(
+            engine, oracle, [req(C.REQUEST_TYPE_READ, ident)], NOW + 1
+        )
+        assert resp[0].status_code == C.STATUS_CODE_SUCCESS
+        assert resp[0].record.payload == bytes([tag]) * C.PAYLOAD_SIZE
