@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, is_zero_words
-from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS, mb_parse, mb_pack
+from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS
 
 U32 = jnp.uint32
 

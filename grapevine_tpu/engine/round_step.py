@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..oblivious.primitives import is_zero_words, rank_of
+from ..oblivious.prp import prp2_decrypt
 from ..wire import constants as C
 from ..oram.round import oram_round
 from .responses import assemble_responses
@@ -119,6 +120,7 @@ def engine_round_step(
         "ka": ka,
         "idxs_mb": idxs_mb,
         "cand_idx": cand_idx,
+        "id_key": state.id_key,
         "id_rand": id_rand,
         "free_top0": state.free_top,
         "recipients0": state.recipients,
@@ -137,12 +139,14 @@ def engine_round_step(
     seq = state.seq + U32(b)
 
     # ---- round B: records (verify, insert, mutate, remove) ------------
+    # id words 0-1 are the PRP-encrypted (nonce, block index)
+    # (oblivious/prp.py); mailbox entries store the same encrypted form,
+    # so one decrypt covers explicit-id and zero-id-selected lookups
     create_ok = out_a["create_ok"]
-    lookup_blk = jnp.where(
-        create_ok,
-        out_a["alloc_idx"],
-        jnp.where(id_zero, out_a["sel_blk"], msg_id[:, 0]),
-    )
+    enc_w0 = jnp.where(id_zero, out_a["sel_blk"], msg_id[:, 0])
+    enc_w1 = jnp.where(id_zero, out_a["sel_idw"], msg_id[:, 1])
+    dec_blk = prp2_decrypt(state.id_key, enc_w0, enc_w1, ecfg.rec.height)
+    lookup_blk = jnp.where(create_ok, out_a["alloc_idx"], dec_blk)
     real_b = is_real & (
         create_ok | (~is_create & (~id_zero | out_a["sel_found"]))
     )
@@ -208,6 +212,7 @@ def engine_round_step(
         recipients=recipients,
         seq=seq,
         hash_key=state.hash_key,
+        id_key=state.id_key,
         rng=k_next,
     )
     return new_state, responses, transcripts

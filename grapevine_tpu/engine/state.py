@@ -106,12 +106,13 @@ class EngineState(NamedTuple):
     recipients: jax.Array  # u32 scalar: live recipients
     seq: jax.Array  # u32 scalar: global insertion counter
     hash_key: jax.Array  # u32[2]: keyed mailbox-bucket PRF
+    id_key: jax.Array  # u32[4]: block-index PRP key (oblivious/prp.py)
     rng: jax.Array  # jax PRNG key
 
 
 def init_engine(ecfg: EngineConfig, seed: int = 0) -> EngineState:
     key = jax.random.PRNGKey(seed)
-    k_rec, k_mb, k_hash, k_rng = jax.random.split(key, 4)
+    k_rec, k_mb, k_hash, k_id, k_rng = jax.random.split(key, 5)
     return EngineState(
         rec=init_oram(ecfg.rec, k_rec),
         mb=init_oram(ecfg.mb, k_mb),
@@ -120,6 +121,7 @@ def init_engine(ecfg: EngineConfig, seed: int = 0) -> EngineState:
         recipients=jnp.uint32(0),
         seq=jnp.uint32(1),
         hash_key=jax.random.bits(k_hash, (2,), U32),
+        id_key=jax.random.bits(k_id, (4,), U32),
         rng=k_rng,
     )
 

@@ -24,11 +24,14 @@ touches the two ORAMs in the identical pattern, grapevine.proto:120-122):
   removal (needed B's sender check), UPDATE's entry-timestamp refresh
   (keeps mailbox expiry in sync with the record), dummies elsewhere.
 
-The msg_id returned by CREATE is [block_index, r1|1, r2, r3] — random and
-nonzero as required (grapevine.proto:66-79), with the block index embedded
-so lookup needs no id→block map; MESSAGE_ID_ALREADY_IN_USE is therefore
-structurally unreachable (the reference deems collisions "unlikely"; here
-they are impossible).
+The msg_id returned by CREATE is [PRP(nonce, block_index), r2, r3|1] —
+random and nonzero as required (grapevine.proto:66-79). Words 0-1 are
+the record's physical block index plus a fresh 32-bit nonce, jointly
+encrypted under a secret per-bus Feistel PRP (oblivious/prp.py), so
+lookup needs no id→block oblivious map while clients learn nothing
+about allocator state from their ids (the nonce keeps LIFO block reuse
+invisible); MESSAGE_ID_ALREADY_IN_USE is structurally unreachable (the
+reference deems collisions "unlikely"; here the id map is a bijection).
 """
 
 from __future__ import annotations
@@ -43,6 +46,7 @@ from ..oblivious.primitives import (
     onehot_select,
     words_equal,
 )
+from ..oblivious.prp import prp2_decrypt, prp2_encrypt
 from ..wire import constants as C
 from ..oram.path_oram import oram_access
 from .responses import assemble_responses
@@ -127,7 +131,7 @@ def _phase_a(ecfg: EngineConfig, value, present, o):
 
     # --- apply append / removal to the target mailbox ------------------
     append_oh = first_true_onehot(~ent_valid) & create_ok
-    new_entry = jnp.stack([o["alloc_idx"], o["new_id"][1], o["seq"], o["now"]])
+    new_entry = jnp.stack([o["new_id"][0], o["new_id"][1], o["seq"], o["now"]])
     ent_mod = jnp.where(append_oh[:, None], new_entry[None, :], tgt_entries)
     ent_mod = jnp.where((rm_oh & rm_a)[:, None], jnp.zeros((4,), U32)[None, :], ent_mod)
 
@@ -285,7 +289,10 @@ def engine_step(
         can_alloc = carry.free_top > 0
         alloc_pos = jnp.where(can_alloc, carry.free_top - 1, 0)
         alloc_idx = carry.freelist[alloc_pos]
-        new_id = jnp.stack([alloc_idx, idr[0] | 1, idr[1], idr[2]])
+        # id words 0-1 = PRP-encrypted (nonce, block index); word 3 odd
+        # so a real id is never all-zeroes (oblivious/prp.py)
+        w0, w1 = prp2_encrypt(carry.id_key, alloc_idx, idr[0], ecfg.rec.height)
+        new_id = jnp.stack([w0, w1, idr[1], idr[2] | 1])
 
         # operative mailbox key: the recipient for create / explicit-id ops,
         # the caller for zero-id next-message ops
@@ -325,10 +332,12 @@ def engine_step(
         o.update(out_a)
 
         # -- phase B: records ------------------------------------------
+        enc_w0 = jnp.where(id_zero, out_a["sel_blk"], msg_id[0])
+        enc_w1 = jnp.where(id_zero, out_a["sel_idw"], msg_id[1])
         lookup_blk = jnp.where(
             out_a["create_ok"],
             alloc_idx,
-            jnp.where(id_zero, out_a["sel_blk"], msg_id[0]),
+            prp2_decrypt(carry.id_key, enc_w0, enc_w1, ecfg.rec.height),
         )
         real_b = is_real & (
             out_a["create_ok"]
@@ -398,6 +407,7 @@ def engine_step(
             recipients=recipients,
             seq=seq,
             hash_key=carry.hash_key,
+            id_key=carry.id_key,
             rng=carry.rng,
         )
         return carry, (resp, transcript)

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from ..oblivious.primitives import is_zero_words, rank_of, words_equal
+from ..oblivious.prp import prp2_encrypt
 from ..oblivious.segmented import (
     group_sort,
     sat_apply,
@@ -328,10 +329,16 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # --- allocation + ids (n-th successful create takes candidate n)
         grank = rank_of(create_ok)
         alloc_idx = ctx["cand_idx"][jnp.minimum(grank, b - 1)]
+        # id words 0-1 = PRP-encrypted (nonce, block index): decodable
+        # on-device, fresh random-looking values on every create even
+        # when the LIFO freelist reuses a block (oblivious/prp.py; the
+        # reference's random-id requirement, grapevine.proto:66-79).
+        # Word 3 is forced odd so a real id is never all-zeroes.
         idr = ctx["id_rand"]
-        new_id = jnp.stack(
-            [alloc_idx, idr[:, 0] | U32(1), idr[:, 1], idr[:, 2]], axis=1
+        w0, w1 = prp2_encrypt(
+            ctx["id_key"], alloc_idx, idr[:, 0], ecfg.rec.height
         )
+        new_id = jnp.stack([w0, w1, idr[:, 1], idr[:, 2] | U32(1)], axis=1)
 
         # --- zero-id selection: p-th oldest of [initial sorted ++ creates]
         pops_before = _counts_before(requal, pop_ok)
@@ -349,7 +356,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         sel_created_oh = (
             requal & create_ok[None, :] & (crank[None, :] == q[:, None])
         )
-        created_blk = jnp.sum(sel_created_oh * alloc_idx[None, :], axis=1).astype(U32)
+        created_blk = jnp.sum(sel_created_oh * new_id[None, :, 0], axis=1).astype(U32)
         created_idw = jnp.sum(sel_created_oh * new_id[None, :, 1], axis=1).astype(U32)
         sel_blk = jnp.where(sel_from_init, init_sel[:, ENT_BLK], created_blk)
         sel_idw = jnp.where(sel_from_init, init_sel[:, ENT_IDW], created_idw)
@@ -439,7 +446,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             jnp.where(surv, pos.astype(U32), U32(cap)),
         )
         new_entry = jnp.stack(
-            [alloc_idx, new_id[:, 1], ctx["seq0"] + iota, jnp.full((b,), now, U32)],
+            [new_id[:, 0], new_id[:, 1], ctx["seq0"] + iota, jnp.full((b,), now, U32)],
             axis=1,
         )
         ents_fin = ents_fin.at[etgt].set(new_entry, mode="drop")

@@ -1,0 +1,119 @@
+"""Keyed small-domain PRP: block indices → random-looking id words.
+
+The reference assigns fully random nonzero msg_ids precisely so onlookers
+cannot probe id structure (reference grapevine.proto:66-79). This engine
+embeds the record's physical block index in the id so lookup needs no
+id→block oblivious map — but a raw index would leak allocator state
+(LIFO free-list position ⇒ a proxy for global create/delete volume) to
+every client through its own ids. Instead id words 0-1 are the Feistel
+encryption of ``(block_index, fresh 32-bit nonce)`` under a secret
+per-bus key — a bijection on the ``bits + 32``-bit joint space
+(``bits = log2(max_messages)``), so ids remain collision-free among live
+records and decodable on the device in a few vector ops, while clients
+see fresh random-looking values on every create. The nonce matters: the
+free list is LIFO, so a deterministic single-word PRP would hand a
+create→delete→create client the *same* ciphertext back — a repeatable
+1-bit probe of whether anyone else created in between. With the nonce in
+the plaintext every encryption is fresh (Luby-Rackoff; the adversary
+never gets an encryption/decryption oracle here, ids only ever flow
+engine→client).
+
+Visible structure: ciphertext word 1 is always < 2**bits — this reveals
+only the bus capacity order, a public config value.
+
+Obliviousness note: encrypt/decrypt are branchless fixed-shape jnp ops,
+identical work for every op — nothing about the transcript depends on
+the key or plaintext.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+ROUNDS = 4
+
+
+def _f(x: jax.Array, k: jax.Array) -> jax.Array:
+    """Murmur-style one-way mixer: (half, round key) → u32."""
+    x = (x ^ k) * U32(0xCC9E2D51)
+    x = ((x << 15) | (x >> 17)) * U32(0x1B873593)
+    x = x ^ (x >> 13)
+    x = x * U32(0x85EBCA6B)
+    return x ^ (x >> 16)
+
+
+def _halves(bits: int) -> list[tuple[int, int]]:
+    """(left, right) bit widths per round; halves swap every round."""
+    a, b = bits - (bits // 2), bits // 2
+    out = []
+    for _ in range(ROUNDS):
+        out.append((a, b))
+        a, b = b, a
+    return out
+
+
+def prp_encrypt(key: jax.Array, x: jax.Array, bits: int) -> jax.Array:
+    """Bijection on [0, 2**bits); key u32[ROUNDS]; x u32[...]. Bits above
+    ``bits`` are ignored on input and zero on output."""
+    if bits <= 1:
+        return x & U32((1 << bits) - 1)
+    sizes = _halves(bits)
+    a0, b0 = sizes[0]
+    left = (x >> b0) & U32((1 << a0) - 1)
+    right = x & U32((1 << b0) - 1)
+    for i, (a, b) in enumerate(sizes):
+        left, right = right, left ^ (_f(right, key[i]) & U32((1 << a) - 1))
+    # after ROUNDS (even) swaps the widths are back to (a0, b0)
+    return (left << b0) | right
+
+
+def prp_decrypt(key: jax.Array, y: jax.Array, bits: int) -> jax.Array:
+    if bits <= 1:
+        return y & U32((1 << bits) - 1)
+    sizes = _halves(bits)
+    a0, b0 = sizes[0]
+    left = (y >> b0) & U32((1 << a0) - 1)
+    right = y & U32((1 << b0) - 1)
+    for i in range(ROUNDS - 1, -1, -1):
+        a, _b = sizes[i]
+        left, right = right ^ (_f(left, key[i]) & U32((1 << a) - 1)), left
+    return (left << b0) | right
+
+
+def _halves2(bits: int) -> list[tuple[int, int]]:
+    """(left, right) widths per round for the two-word PRP: left starts
+    as the 32-bit nonce lane, right as the ``bits``-bit index lane."""
+    a, b = 32, bits
+    out = []
+    for _ in range(ROUNDS):
+        out.append((a, b))
+        a, b = b, a
+    return out
+
+
+def _mask(nbits: int) -> jnp.uint32:
+    return U32(0xFFFFFFFF) if nbits >= 32 else U32((1 << nbits) - 1)
+
+
+def prp2_encrypt(key: jax.Array, x: jax.Array, nonce: jax.Array, bits: int):
+    """Bijection on [0, 2**32) × [0, 2**bits): (nonce, block index) →
+    (word0 u32, word1 < 2**bits). key u32[ROUNDS]; x/nonce u32[...]."""
+    left = nonce
+    right = x & _mask(bits)
+    for i, (a, _b) in enumerate(_halves2(bits)):
+        left, right = right, left ^ (_f(right, key[i]) & _mask(a))
+    # ROUNDS is even ⇒ widths are back to (32, bits)
+    return left, right
+
+
+def prp2_decrypt(key: jax.Array, w0: jax.Array, w1: jax.Array, bits: int):
+    """Inverse of prp2_encrypt; returns the block index (nonce discarded)."""
+    sizes = _halves2(bits)
+    left, right = w0, w1 & _mask(bits)
+    for i in range(ROUNDS - 1, -1, -1):
+        a, _b = sizes[i]
+        left, right = right ^ (_f(left, key[i]) & _mask(a)), left
+    return right  # (left, right) = (nonce, index)

@@ -117,9 +117,7 @@ def group_sort(group: jax.Array):
     ``x[perm]`` is segment-contiguous, ``y[inv]`` undoes it, and
     seg_start marks group boundaries in sorted order.
     """
-    b = group.shape[0]
-    iota = jnp.arange(b, dtype=jnp.uint32)
-    perm = jnp.argsort(group * jnp.uint32(b) + iota)  # stable by construction
+    perm = jnp.argsort(group, stable=True)  # stable ⇒ slot order within groups
     sorted_g = group[perm]
     seg_start = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), sorted_g[1:] != sorted_g[:-1]]

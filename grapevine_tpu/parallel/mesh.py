@@ -69,6 +69,7 @@ def engine_state_specs() -> EngineState:
         recipients=P(),
         seq=P(),
         hash_key=P(),
+        id_key=P(),
         rng=P(),
     )
 

@@ -1,0 +1,163 @@
+"""Block-index PRP (oblivious/prp.py): bijectivity + id-opacity.
+
+The reference requires random-looking nonzero msg_ids so onlookers cannot
+probe id structure (grapevine.proto:66-79); this engine meets it with a
+keyed Feistel bijection over the block-index space.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grapevine_tpu.oblivious.prp import (
+    prp2_decrypt,
+    prp2_encrypt,
+    prp_decrypt,
+    prp_encrypt,
+)
+
+
+@pytest.mark.parametrize("bits", [2, 3, 4, 7, 13, 16, 21])
+def test_prp_bijection(bits):
+    key = jax.random.bits(jax.random.PRNGKey(bits), (4,), jnp.uint32)
+    n = min(1 << bits, 1 << 12)
+    x = jnp.arange(n, dtype=jnp.uint32)
+    y = prp_encrypt(key, x, bits)
+    assert int(jnp.max(y)) < (1 << bits)
+    # injective on the sample (and decrypt inverts)
+    assert len(set(np.asarray(y).tolist())) == n
+    np.testing.assert_array_equal(np.asarray(prp_decrypt(key, y, bits)), np.asarray(x))
+
+
+def test_prp_full_domain_permutation():
+    bits = 10
+    key = jax.random.bits(jax.random.PRNGKey(7), (4,), jnp.uint32)
+    x = jnp.arange(1 << bits, dtype=jnp.uint32)
+    y = np.asarray(prp_encrypt(key, x, bits))
+    assert sorted(y.tolist()) == list(range(1 << bits))
+
+
+def test_prp_hides_sequential_structure():
+    """Sequential plaintexts must not map to correlated ciphertexts: the
+    top half of the index space should be hit ~half the time by the
+    image of the bottom quarter (a raw or affine embedding would not)."""
+    bits = 16
+    key = jax.random.bits(jax.random.PRNGKey(3), (4,), jnp.uint32)
+    x = jnp.arange(1 << 14, dtype=jnp.uint32)  # bottom quarter
+    y = np.asarray(prp_encrypt(key, x, bits))
+    frac_top = float((y >= (1 << 15)).mean())
+    assert 0.4 < frac_top < 0.6
+    # and keys matter
+    key2 = jax.random.bits(jax.random.PRNGKey(4), (4,), jnp.uint32)
+    y2 = np.asarray(prp_encrypt(key2, x, bits))
+    assert (y != y2).mean() > 0.9
+
+
+@pytest.mark.parametrize("bits", [2, 4, 13, 20, 31, 32])
+def test_prp2_roundtrip_and_freshness(bits):
+    key = jax.random.bits(jax.random.PRNGKey(bits), (4,), jnp.uint32)
+    n = 1 << 10
+    x = jnp.arange(n, dtype=jnp.uint32) % (1 << min(bits, 30))
+    nonces = jax.random.bits(jax.random.PRNGKey(99), (n,), jnp.uint32)
+    w0, w1 = prp2_encrypt(key, x, nonces, bits)
+    assert int(jnp.max(w1)) < (1 << bits) or bits >= 32
+    np.testing.assert_array_equal(
+        np.asarray(prp2_decrypt(key, w0, w1, bits)), np.asarray(x)
+    )
+    # the same index under two nonces gives unrelated ciphertexts — the
+    # LIFO-reuse probe from the round-3 review
+    wa = prp2_encrypt(key, jnp.uint32(5), jnp.uint32(1), bits)
+    wb = prp2_encrypt(key, jnp.uint32(5), jnp.uint32(2), bits)
+    assert (int(wa[0]), int(wa[1])) != (int(wb[0]), int(wb[1]))
+
+
+def test_engine_id_word0_fresh_across_block_reuse():
+    """create → delete → create reuses the LIFO block; the id must still
+    change in every word pair (no allocator-state probe)."""
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cfg = GrapevineConfig(
+        max_messages=64, max_recipients=8, mailbox_cap=4, batch_size=2
+    )
+    engine = GrapevineEngine(cfg, seed=2)
+    me = b"\x05" * 32
+
+    def create():
+        r = engine.handle_queries(
+            [
+                QueryRequest(
+                    request_type=C.REQUEST_TYPE_CREATE,
+                    auth_identity=me,
+                    auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                    record=RequestRecord(
+                        msg_id=C.ZERO_MSG_ID,
+                        recipient=me,
+                        payload=b"\x09" * C.PAYLOAD_SIZE,
+                    ),
+                )
+            ],
+            1_700_000_000,
+        )[0]
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+        return r.record.msg_id
+
+    def delete(mid):
+        r = engine.handle_queries(
+            [
+                QueryRequest(
+                    request_type=C.REQUEST_TYPE_DELETE,
+                    auth_identity=me,
+                    auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                    record=RequestRecord(
+                        msg_id=mid, recipient=me, payload=b"\x00" * C.PAYLOAD_SIZE
+                    ),
+                )
+            ],
+            1_700_000_000,
+        )[0]
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+
+    seen = set()
+    for _ in range(6):
+        mid = create()
+        assert mid[:8] not in seen, "id words 0-1 repeated across block reuse"
+        seen.add(mid[:8])
+        delete(mid)
+
+
+def test_engine_ids_do_not_reveal_allocation_order():
+    """End-to-end: consecutive creates' id word 0 must not be consecutive
+    block indices (the round-2 verdict's allocator-state leak)."""
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cfg = GrapevineConfig(
+        max_messages=256, max_recipients=8, mailbox_cap=8, batch_size=4
+    )
+    engine = GrapevineEngine(cfg, seed=1)
+    ident = b"\x01" * 32
+    reqs = [
+        QueryRequest(
+            request_type=C.REQUEST_TYPE_CREATE,
+            auth_identity=ident,
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID,
+                recipient=ident,
+                payload=bytes([i]) * C.PAYLOAD_SIZE,
+            ),
+        )
+        for i in range(8)
+    ]
+    resps = engine.handle_queries(reqs, 1_700_000_000)
+    words = [int.from_bytes(r.record.msg_id[:4], "little") for r in resps]
+    assert all(r.status_code == C.STATUS_CODE_SUCCESS for r in resps)
+    assert len(set(words)) == len(words)
+    diffs = {b - a for a, b in zip(words, words[1:])}
+    assert diffs != {1} and diffs != {-1}, "ids expose allocation order"
