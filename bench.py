@@ -271,12 +271,88 @@ def bench_sharded(smoke):
             "batch": batch, "capacity_log2": cap.bit_length() - 1, "mesh": n_dev}
 
 
+def bench_server_loopback(smoke):
+    """End-to-end gRPC loopback: in-process server (session crypto +
+    challenge lockstep + batched signature verification + engine),
+    concurrent authenticated clients. Exposes the full-stack throughput
+    the engine-only configs skip (VERDICT r2: the auth path capped the
+    server at O(100) ops/s before batch verification)."""
+    import threading
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.server.client import GrapevineClient
+    from grapevine_tpu.server.service import GrapevineServer
+    from grapevine_tpu.wire import constants as C
+
+    cap, n_clients, per_client = (1 << 10, 2, 4) if smoke else (1 << 16, 16, 24)
+    cfg = GrapevineConfig(
+        max_messages=cap,
+        max_recipients=1 << 10,
+        batch_size=16,
+        bucket_cipher_rounds=0 if smoke else 8,
+    )
+    server = GrapevineServer(config=cfg, max_wait_ms=3.0)
+    port = server.start("insecure-grapevine://127.0.0.1:0")
+    try:
+        clients = [
+            GrapevineClient(
+                f"insecure-grapevine://127.0.0.1:{port}",
+                identity_seed=bytes([i + 1]) * 32,
+            )
+            for i in range(n_clients)
+        ]
+        for c in clients:
+            c.auth()
+        errs = []
+        lat: list[float] = []
+        lock = threading.Lock()
+
+        def run(c, peer):
+            try:
+                for i in range(per_client):
+                    t0 = time.perf_counter()
+                    r = c.create(recipient=peer.public_key,
+                                 payload=bytes([i & 0xFF]) * C.PAYLOAD_SIZE)
+                    assert r.status_code == C.STATUS_CODE_SUCCESS, r.status_code
+                    r2 = c.read()  # zero-id pop of my own inbox (may be empty)
+                    assert r2.status_code in (
+                        C.STATUS_CODE_SUCCESS,
+                        C.STATUS_CODE_NOT_FOUND,
+                    )
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=run, args=(c, clients[(j + 1) % n_clients]))
+            for j, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = time.perf_counter() - t0
+        assert not errs, errs[0]
+        ops = n_clients * per_client * 2  # create + read per iteration
+        return {
+            "ops_per_sec": round(ops / total, 1),
+            "p99_pair_ms": round(_p99(lat), 2),
+            "clients": n_clients,
+            "capacity_log2": cap.bit_length() - 1,
+        }
+    finally:
+        server.stop()
+
+
 CONFIGS = [
     ("crd_loop", bench_crd_loop),
     ("batched_read", bench_batched_read),
     ("zipf_mixed", bench_zipf_mixed),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
+    ("server_loopback", bench_server_loopback),
 ]
 
 

@@ -52,16 +52,49 @@ class GrapevineConfig:
     #: Identical semantics for single-op batches; batch-hazard semantics
     #: documented in round_step.py.
     commit: str = "phase"
+    #: ChaCha rounds for at-rest bucket-tree encryption in HBM — the EPC
+    #: analog (oblivious/bucket_cipher.py). 8 = ChaCha8 (default),
+    #: 20 = RFC ChaCha20, 0 = plaintext trees.
+    bucket_cipher_rounds: int = 8
 
     def __post_init__(self):
         if self.commit not in ("phase", "op"):
             raise ValueError(
                 f"commit must be 'phase' or 'op', got {self.commit!r}"
             )
-    #: per-slot load target; table buckets = ceil(
-    #: max_recipients / (mailbox_slots * mailbox_load)). Low load keeps the
-    #: single-choice hash table's overflow probability negligible; a
-    #: relocating cuckoo scheme is a planned later optimization.
+        # 0 = plaintext; otherwise an even round count ≥ 8 (ChaCha rounds
+        # come in column+diagonal pairs; odd values would silently floor,
+        # and rounds < 8 have no security story — a 0-round "cipher"
+        # exposes 2*key in every keystream block)
+        r = self.bucket_cipher_rounds
+        if r != 0 and (r < 8 or r % 2 != 0):
+            raise ValueError(
+                f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
+            )
+    #: per-slot load target; table buckets M = ceil(
+    #: max_recipients / (mailbox_slots * mailbox_load)).
+    #:
+    #: The mailbox tier is a keyed SINGLE-CHOICE hash table of K-slot
+    #: buckets, not the reference's bucketed cuckoo (README.md:78-80).
+    #: The quantified bargain (tests/test_mailbox_load.py):
+    #:
+    #: - **Early failures**: a recipient whose bucket is full gets
+    #:   TOO_MANY_RECIPIENTS before the aggregate cap is reached. With
+    #:   R = fill · max_recipients uniform recipients, per-bucket
+    #:   occupancy is ≈ Poisson(λ = K·load·fill); expected early
+    #:   failures ≈ M · P(X ≥ K+1). At the default (K=4, load=0.125):
+    #:   fill 50% ⇒ λ=0.25, P ≈ 6.6e-6 (≈0.05 expected at M=8192);
+    #:   fill 100% ⇒ λ=0.5, P ≈ 1.7e-4 (≈1.4 expected at M=8192) —
+    #:   i.e. near the aggregate cap, a handful of recipients may be
+    #:   refused early. The spec permits TOO_MANY_RECIPIENTS at any
+    #:   recipient count; the oracle models only the aggregate cap, so
+    #:   randomized oracle-equality suites run at low fill.
+    #: - **Memory**: mailbox-tier HBM per recipient is 1/load × the
+    #:   mailbox size — 8× at the default (the price of no relocation).
+    #:
+    #: A relocating oblivious cuckoo scheme (bounded-iteration masked
+    #: eviction chains) would shrink memory to ~2× and kill early
+    #: failures; it costs a second path fetch per op. Planned.
     mailbox_load: float = 0.125
 
     @property

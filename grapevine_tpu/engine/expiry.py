@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, is_zero_words
+from ..oram.path_oram import decrypt_tree, encrypt_tree
 from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS
 
 U32 = jnp.uint32
@@ -35,6 +36,14 @@ def _expired(ts: jnp.ndarray, now, period) -> jnp.ndarray:
 def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineState:
     now = U32(now)
     period = U32(period)
+
+    # at-rest bucket cipher: the sweep is a whole-tree pass (uniform
+    # transcript), so decrypt both trees up front and re-encrypt them
+    # under a fresh epoch at the end (oram/path_oram.py helpers, chunked)
+    state = state._replace(
+        rec=decrypt_tree(ecfg.rec, state.rec),
+        mb=decrypt_tree(ecfg.mb, state.mb),
+    )
 
     # --- records ORAM: invalidate expired blocks -----------------------
     def sweep_records(idx, ts):
@@ -112,8 +121,8 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
     free_top = (n - jnp.sum(present)).astype(U32)
 
     return state._replace(
-        rec=rec,
-        mb=mb,
+        rec=encrypt_tree(ecfg.rec, rec),
+        mb=encrypt_tree(ecfg.mb, mb),
         freelist=freelist,
         free_top=free_top,
         recipients=recipients,

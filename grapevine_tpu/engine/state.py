@@ -86,12 +86,14 @@ class EngineConfig:
                 value_words=REC_WORDS,
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
+                cipher_rounds=cfg.bucket_cipher_rounds,
             ),
             mb=OramConfig(
                 height=cfg.mailbox_height,
                 value_words=mb_value_words,
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
+                cipher_rounds=cfg.bucket_cipher_rounds,
             ),
             mb_table_buckets=m,
             mb_slots=k,

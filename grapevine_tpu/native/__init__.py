@@ -1,0 +1,88 @@
+"""Build-on-first-import ctypes loader for the native session library.
+
+r255.c is compiled with the system C compiler into a cached shared
+object next to the source (the build-time codegen analog of the
+reference's api/build.rs protoc step). If no compiler is available the
+package degrades to the pure-Python paths — callers must treat ``lib``
+as Optional.
+
+Thread-safety: the C library uses static scratch buffers (it is called
+from the scheduler's single collector thread); the wrapper serializes
+calls with a module lock anyway so other callers stay safe.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "r255.c"
+_SO = _DIR / "_r255.so"
+
+_lock = threading.Lock()
+lib = None
+
+
+def _build() -> Path | None:
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    # compile to a private temp file, then atomically rename: concurrent
+    # importers (pytest workers, server + bench) must never dlopen a
+    # half-written .so or have a mapped one rewritten under them
+    tmp = _DIR / f"_r255.{os.getpid()}.tmp.so"
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
+        return None
+    return _SO
+
+
+def _load():
+    global lib
+    so = _build()
+    if so is None:
+        return None
+    try:
+        handle = ctypes.CDLL(str(so))
+    except OSError:
+        return None
+    handle.r255_init.restype = ctypes.c_int
+    handle.r255_verify1.restype = ctypes.c_int
+    handle.r255_verify1.argtypes = [ctypes.c_char_p] * 4
+    handle.r255_batch_check.restype = ctypes.c_int
+    handle.r255_batch_check.argtypes = [ctypes.c_size_t] + [ctypes.c_char_p] * 5
+    handle.r255_encode.restype = ctypes.c_int
+    handle.r255_encode.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    if handle.r255_init() != 0:
+        return None
+    return handle
+
+
+lib = _load()
+
+
+def verify1(pub: bytes, r_enc: bytes, s: bytes, k: bytes) -> int:
+    """1 valid, 0 invalid, -1 malformed. Requires ``lib is not None``."""
+    with _lock:
+        return lib.r255_verify1(pub, r_enc, s, k)
+
+
+def batch_check(rs: bytes, as_: bytes, z: bytes, zk: bytes, sb: bytes) -> int:
+    n = len(rs) // 32
+    with _lock:
+        return lib.r255_batch_check(n, rs, as_, z, zk, sb)
+
+
+def reencode(enc: bytes) -> bytes | None:
+    out = ctypes.create_string_buffer(32)
+    with _lock:
+        rc = lib.r255_encode(out, enc)
+    return bytes(out.raw) if rc == 0 else None

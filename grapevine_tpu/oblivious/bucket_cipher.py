@@ -1,0 +1,132 @@
+"""BucketCipher: on-device keystream encryption of HBM bucket rows.
+
+In the reference, ORAM contents live inside SGX's hardware-encrypted EPC
+(reference README.md:16,49) — the operator snapshotting RAM sees only
+ciphertext. A TPU has no enclave, so this module supplies the equivalent
+property for the bucket trees at rest in HBM: every bucket row is XORed
+with a ChaCha keystream keyed by a device-resident secret, the bucket's
+heap index, and a per-write epoch nonce, so
+
+- a memory snapshot reveals nothing about record contents or slot
+  metadata (which blocks live where);
+- rewriting a bucket with identical plaintext yields fresh ciphertext
+  (the epoch advances every round), so snapshot diffing shows only
+  *that* the transcript's buckets were written — which the transcript
+  already reveals.
+
+Cipher: RFC 7539 ChaCha block function on the 16-word state
+``[consts | key(8) | block_ctr | bucket | epoch | 0]`` — i.e. standard
+ChaCha with counter = in-row block index and nonce = (bucket, epoch, 0),
+vectorized over rows and blocks in pure jnp (fully fused by XLA; the
+MXU is untouched, this rides the VPU). ``rounds`` is configurable:
+20 = RFC ChaCha20; the engine default is 8 (ChaCha8, unbroken, standard
+in perf-sensitive deployments) because keystream cost scales linearly
+with rounds. SURVEY.md §7 hard-part 3 names AES-CTR with a documented
+fallback: this is that documented fallback — AES without AES-NI/VPU
+byte-shuffles would be a bitsliced Pallas project for strictly worse
+throughput at no security gain over ChaCha.
+
+Epoch-0 convention: ``nonce == 0`` marks a never-written bucket and maps
+to the identity keystream (the all-zero initial tree is its own
+ciphertext). The operator learns which buckets were never written —
+information the public access transcript already contains. The keystream
+is still *computed* for every row and masked, so work is
+content-independent.
+
+The stash, position map, and freelist stay plaintext: they are private
+working state (the EPC analog — see the threat model in
+oram/path_oram.py), not part of the HBM bucket-tree surface this cipher
+protects. Key material (u32[8]) lives in OramState, never in the tree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+#: "expand 32-byte k"
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _rotl(x: jax.Array, n: int) -> jax.Array:
+    return (x << U32(n)) | (x >> U32(32 - n))
+
+
+def _qr(s, a, b, c, d):
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+def chacha_blocks(
+    key: jax.Array,  # u32[8]
+    counter: jax.Array,  # u32[...] block counter per lane
+    n1: jax.Array,  # u32[...] nonce word 1 (bucket heap index)
+    n2: jax.Array,  # u32[...] nonce word 2 (write epoch, low word)
+    n3: jax.Array | None = None,  # u32[...] nonce word 3 (epoch, high word)
+    rounds: int = 8,
+) -> jax.Array:
+    """ChaCha block function, vectorized: → u32[..., 16] keystream."""
+    zero = jnp.zeros_like(counter) if n3 is None else jnp.broadcast_to(n3, counter.shape)
+    init = [jnp.broadcast_to(U32(c), counter.shape) for c in _SIGMA]
+    init += [jnp.broadcast_to(key[i], counter.shape) for i in range(8)]
+    init += [counter, n1, n2, zero]
+    s = list(init)
+    for _ in range(rounds // 2):
+        _qr(s, 0, 4, 8, 12)
+        _qr(s, 1, 5, 9, 13)
+        _qr(s, 2, 6, 10, 14)
+        _qr(s, 3, 7, 11, 15)
+        _qr(s, 0, 5, 10, 15)
+        _qr(s, 1, 6, 11, 12)
+        _qr(s, 2, 7, 8, 13)
+        _qr(s, 3, 4, 9, 14)
+    return jnp.stack([a + b for a, b in zip(s, init)], axis=-1)
+
+
+def row_keystream(
+    key: jax.Array,  # u32[8]
+    bucket: jax.Array,  # u32[R]
+    epoch: jax.Array,  # u32[R, 2] (lo, hi); 0 = identity (never written)
+    n_words: int,
+    rounds: int = 8,
+) -> jax.Array:
+    """Keystream rows u32[R, n_words]; zero rows where epoch == 0.
+
+    The epoch is 64 bits across two nonce words, so the per-round write
+    counter cannot wrap within any feasible bus lifetime — a u32 epoch
+    would wrap after 2^32 rounds (~1.4 years at 100 rounds/s), landing
+    one access in plaintext (epoch 0) and replaying every historical
+    (bucket, epoch) pair into a two-time pad for a snapshot-diffing
+    operator."""
+    r = bucket.shape[0]
+    n_blocks = (n_words + 15) // 16
+    ctr = jnp.broadcast_to(
+        jnp.arange(n_blocks, dtype=U32)[None, :], (r, n_blocks)
+    )
+    ks = chacha_blocks(
+        key, ctr, bucket[:, None], epoch[:, None, 0], epoch[:, None, 1], rounds
+    ).reshape(r, n_blocks * 16)[:, :n_words]
+    written = (epoch[:, 0] != 0) | (epoch[:, 1] != 0)
+    return jnp.where(written[:, None], ks, U32(0))
+
+
+def epoch_next(epoch: jax.Array) -> jax.Array:
+    """Advance a u32[2] (lo, hi) epoch counter with carry."""
+    lo = epoch[0] + U32(1)
+    hi = epoch[1] + jnp.where(lo == 0, U32(1), U32(0))
+    return jnp.stack([lo, hi])
+
+
+# NOTE: whole-tree passes (the expiry sweep) decrypt/re-encrypt entire
+# rows via oram/path_oram.py:decrypt_tree/encrypt_tree; there is no
+# partial-word decrypt API on purpose — CTR-mode random access would
+# permit one, but nothing uses it and the sweep's cost model is the
+# full-row recrypt documented there.

@@ -55,9 +55,30 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..oblivious.bucket_cipher import epoch_next, row_keystream
 from ..oblivious.primitives import SENTINEL, first_true_onehot, onehot_select, rank_of
 
 U32 = jnp.uint32
+
+
+def cipher_rows(
+    cfg: "OramConfig",
+    key: jax.Array,
+    buckets: jax.Array,  # u32[R] heap bucket ids
+    epochs: jax.Array,  # u32[R, 2] per-row (lo, hi) nonce (0 = identity)
+    pidx: jax.Array,  # u32[R, Z]
+    pval: jax.Array,  # u32[R, Z*V]
+):
+    """XOR bucket rows with their keystream (encrypt ≡ decrypt).
+
+    One ChaCha stream per (bucket, epoch) covers the Z slot-index words
+    followed by the Z*V value words — a memory snapshot of the tree
+    arrays reveals neither slot occupancy nor contents."""
+    if not cfg.encrypted:
+        return pidx, pval
+    z = cfg.bucket_slots
+    ks = row_keystream(key, buckets, epochs, cfg.row_words, cfg.cipher_rounds)
+    return pidx ^ ks[:, :z], pval ^ ks[:, z:]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +89,19 @@ class OramConfig:
     value_words: int  # uint32 words per block value
     bucket_slots: int = 4  # Z
     stash_size: int = 96
+    #: ChaCha rounds for at-rest bucket encryption; 0 disables the
+    #: cipher (oblivious/bucket_cipher.py — the EPC-encryption analog)
+    cipher_rounds: int = 0
+
+    @property
+    def encrypted(self) -> bool:
+        return self.cipher_rounds > 0
+
+    @property
+    def row_words(self) -> int:
+        """Keystream width per bucket: Z slot-index words + Z*V value
+        words, enciphered as one row under one (bucket, epoch) nonce."""
+        return self.bucket_slots + self.bucket_slots * self.value_words
 
     @property
     def leaves(self) -> int:
@@ -118,20 +152,79 @@ class OramState(NamedTuple):
     stash_val: jax.Array  # u32[S, V]
     posmap: jax.Array  # u32[leaves + 1] (last entry backs the dummy index)
     overflow: jax.Array  # u32 scalar, sticky count of dropped blocks
+    #: at-rest cipher state (zero-sized semantics when cfg.cipher_rounds
+    #: == 0): per-bucket 64-bit write-epoch nonce (0 = never written ⇒
+    #: identity keystream), the ChaCha key, and the global epoch counter
+    nonces: jax.Array  # u32[n_buckets_padded, 2] (lo, hi)
+    cipher_key: jax.Array  # u32[8]
+    epoch: jax.Array  # u32[2] (lo, hi), next write epoch (starts at 1)
 
 
 def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
-    """Empty tree; position map initialized with uniform random leaves."""
+    """Empty tree; position map initialized with uniform random leaves.
+
+    With the cipher enabled the all-zero initial tree is its own
+    ciphertext (epoch-0 convention, oblivious/bucket_cipher.py)."""
     z, v = cfg.bucket_slots, cfg.value_words
+    k_pos, k_cipher = jax.random.split(key)
     return OramState(
         tree_idx=jnp.full((cfg.n_buckets_padded * z,), SENTINEL, U32),
         tree_val=jnp.zeros((cfg.n_buckets_padded, z * v), U32),
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
         posmap=jax.random.randint(
-            key, (cfg.leaves + 1,), 0, cfg.leaves, dtype=jnp.int32
+            k_pos, (cfg.leaves + 1,), 0, cfg.leaves, dtype=jnp.int32
         ).astype(U32),
         overflow=jnp.zeros((), U32),
+        nonces=jnp.zeros((cfg.n_buckets_padded, 2), U32),
+        cipher_key=jax.random.bits(k_cipher, (8,), U32),
+        epoch=jnp.array([1, 0], U32),
+    )
+
+
+def _xor_tree(cfg: OramConfig, key: jax.Array, tree_idx, tree_val, epochs):
+    """XOR every bucket row with its keystream, chunked under lax.scan so
+    the full-tree keystream (GBs at 2^20+) never materializes."""
+    z, v = cfg.bucket_slots, cfg.value_words
+    n = cfg.n_buckets_padded
+    rpc = 1  # rows per chunk: power of two, ~8M words of keystream
+    while rpc * 2 <= n and rpc * 2 * cfg.row_words <= (1 << 23):
+        rpc *= 2
+    nch = n // rpc
+    bids = jnp.arange(n, dtype=U32).reshape(nch, rpc)
+    idx3 = tree_idx.reshape(nch, rpc, z)
+    val3 = tree_val.reshape(nch, rpc, z * v)
+    eps = epochs.reshape(nch, rpc, 2)
+
+    def body(_, xs):
+        bid, ix, vl, ep = xs
+        ks = row_keystream(key, bid, ep, cfg.row_words, cfg.cipher_rounds)
+        return None, (ix ^ ks[:, :z], vl ^ ks[:, z:])
+
+    _, (idx_o, val_o) = jax.lax.scan(body, None, (bids, idx3, val3, eps))
+    return idx_o.reshape(tree_idx.shape), val_o.reshape(tree_val.shape)
+
+
+def decrypt_tree(cfg: OramConfig, state: OramState) -> OramState:
+    """Full-tree decrypt to plaintext (nonces → 0). For whole-tree passes
+    (the expiry sweep); per-access work uses cipher_rows on paths."""
+    if not cfg.encrypted:
+        return state
+    idx, val = _xor_tree(cfg, state.cipher_key, state.tree_idx, state.tree_val, state.nonces)
+    return state._replace(
+        tree_idx=idx, tree_val=val, nonces=jnp.zeros_like(state.nonces)
+    )
+
+
+def encrypt_tree(cfg: OramConfig, state: OramState) -> OramState:
+    """Re-encrypt a plaintext tree under the next epoch (every bucket is
+    rewritten — a whole-tree pass is its own uniform transcript)."""
+    if not cfg.encrypted:
+        return state
+    eps = jnp.broadcast_to(state.epoch[None, :], state.nonces.shape)
+    idx, val = _xor_tree(cfg, state.cipher_key, state.tree_idx, state.tree_val, eps)
+    return state._replace(
+        tree_idx=idx, tree_val=val, nonces=eps, epoch=epoch_next(state.epoch)
     )
 
 
@@ -256,7 +349,13 @@ def oram_access(
 
     # --- fetch path ∪ stash into the working set -----------------------
     pidx = _path_gather(state.tree_idx, slot_b, axis_name)
-    pval = _path_gather(state.tree_val, path_b, axis_name).reshape(-1, v)
+    pval = _path_gather(state.tree_val, path_b, axis_name)
+    pnonce = _path_gather(state.nonces, path_b, axis_name)
+    pidx, pval = cipher_rows(
+        cfg, state.cipher_key, path_b, pnonce, pidx.reshape(plen, z), pval
+    )
+    pidx = pidx.reshape(-1)
+    pval = pval.reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
     wval = jnp.concatenate([state.stash_val, pval], axis=0)
     # leaves come from the (already remapped) private posmap: for the
@@ -325,15 +424,32 @@ def oram_access(
     )
 
     # --- write the path back (write transcript ≡ read transcript) ------
+    epochs_w = jnp.broadcast_to(state.epoch[None, :], (plen, 2))
+    enc_pidx, enc_pval = cipher_rows(
+        cfg,
+        state.cipher_key,
+        path_b,
+        epochs_w,
+        new_pidx.reshape(plen, z),
+        new_pval.reshape(plen, z * v),
+    )
+    nonces = (
+        _path_scatter(state.nonces, path_b, epochs_w, axis_name)
+        if cfg.encrypted
+        else state.nonces
+    )
     new_state = OramState(
-        tree_idx=_path_scatter(state.tree_idx, slot_b, new_pidx, axis_name),
-        tree_val=_path_scatter(
-            state.tree_val, path_b, new_pval.reshape(plen, z * v), axis_name
+        tree_idx=_path_scatter(
+            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name
         ),
+        tree_val=_path_scatter(state.tree_val, path_b, enc_pval, axis_name),
         stash_idx=stash_idx,
         stash_val=stash_val,
         posmap=posmap,
         overflow=overflow,
+        nonces=nonces,
+        cipher_key=state.cipher_key,
+        epoch=epoch_next(state.epoch),
     )
     return new_state, out, leaf
 

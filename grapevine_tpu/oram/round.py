@@ -51,11 +51,13 @@ import jax
 import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, rank_of
+from ..oblivious.bucket_cipher import epoch_next
 from .path_oram import (
     OramConfig,
     OramState,
     _path_gather,
     _path_scatter,
+    cipher_rows,
     path_bucket_indices,
     path_slot_indices,
     working_leaves,
@@ -153,6 +155,8 @@ def oram_round(
     slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
     pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(b * plen, z)
     pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
+    pnonce = _path_gather(state.nonces, flat_b, axis_name)
+    pidx, pval = cipher_rows(cfg, state.cipher_key, flat_b, pnonce, pidx, pval)
     # non-owner copies of shared buckets are invalidated
     pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
 
@@ -249,16 +253,33 @@ def oram_round(
     # owner expansion for the flat slot axis: each of a bucket's z slots
     # shares the bucket's owner bit
     fowner_slots = jnp.repeat(fowner, z)
+    epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
+    enc_pidx, enc_pval = cipher_rows(
+        cfg,
+        state.cipher_key,
+        flat_b,
+        epochs_w,
+        new_pidx.reshape(b * plen, z),
+        new_pval.reshape(b * plen, z * v),
+    )
+    nonces = (
+        _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
+        if cfg.encrypted
+        else state.nonces
+    )
     new_state = OramState(
         tree_idx=_path_scatter(
-            state.tree_idx, slot_b, new_pidx, axis_name, fowner_slots
+            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name, fowner_slots
         ),
         tree_val=_path_scatter(
-            state.tree_val, flat_b, new_pval.reshape(b * plen, z * v), axis_name, fowner
+            state.tree_val, flat_b, enc_pval, axis_name, fowner
         ),
         stash_idx=stash_idx,
         stash_val=stash_val,
         posmap=posmap,
         overflow=state.overflow + stash_dropped,
+        nonces=nonces,
+        cipher_key=state.cipher_key,
+        epoch=epoch_next(state.epoch),
     )
     return new_state, outs, leaves

@@ -56,6 +56,9 @@ def _oram_specs() -> OramState:
         stash_val=P(),
         posmap=P(),
         overflow=P(),
+        nonces=P(TREE_AXIS),
+        cipher_key=P(),
+        epoch=P(),
     )
 
 

@@ -1,4 +1,4 @@
-"""Cross-connection request batcher.
+"""Cross-connection request batcher (+ batched signature verification).
 
 The north-star component the reference never needed (its enclave
 serialized per-op ECALLs; SURVEY.md §2c): concurrent gRPC handler threads
@@ -7,6 +7,12 @@ fixed-size engine rounds — up to ``batch_size`` ops or ``max_wait_ms``,
 whichever first. Under-full rounds are dummy-padded by the engine, so the
 device cadence carries no information about load bursts beyond the round
 count itself.
+
+Challenge-signature verification rides the same batching: the round's
+signatures are checked with ONE random-linear-combination multi-scalar
+multiplication (session/ristretto.py:batch_verify — SURVEY.md §2b
+"consider batch verify"); only a failing round pays per-item verification
+to identify offenders, which are rejected without reaching the engine.
 """
 
 from __future__ import annotations
@@ -16,7 +22,15 @@ import time
 from concurrent.futures import Future
 
 from ..engine.batcher import GrapevineEngine
+from ..session import ristretto
 from ..wire.records import QueryRequest, QueryResponse
+
+#: (pub, context, message, signature) as taken by ristretto.verify
+AuthItem = tuple[bytes, bytes, bytes, bytes]
+
+
+class AuthFailure(Exception):
+    """The request's challenge signature did not verify."""
 
 
 class BatchScheduler:
@@ -29,19 +43,25 @@ class BatchScheduler:
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
         self.clock = clock or (lambda: int(time.time()))
-        self._queue: list[tuple[QueryRequest, Future]] = []
+        self._queue: list[tuple[QueryRequest, AuthItem | None, Future]] = []
         self._cv = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
-    def submit(self, req: QueryRequest) -> QueryResponse:
-        """Block until the op's round commits; returns its response."""
+    def submit(
+        self, req: QueryRequest, auth: AuthItem | None = None
+    ) -> QueryResponse:
+        """Block until the op's round commits; returns its response.
+
+        With ``auth`` set, the signature is verified as part of the
+        round's batch; raises AuthFailure (and the op never reaches the
+        engine) if it does not verify."""
         fut: Future = Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("scheduler closed")
-            self._queue.append((req, fut))
+            self._queue.append((req, auth, fut))
             self._cv.notify()
         return fut.result()
 
@@ -60,13 +80,48 @@ class BatchScheduler:
                         break
                     self._cv.wait(timeout=remaining)
                 chunk, self._queue = self._queue[:bs], self._queue[bs:]
-            reqs = [r for r, _ in chunk]
+
+            # --- one multi-scalar multiplication for the round --------
+            authed = [i for i, (_, a, _) in enumerate(chunk) if a is not None]
+            rejected: set[int] = set()
+            if authed and not ristretto.batch_verify(
+                [chunk[i][1] for i in authed]
+            ):
+                # bisect to the offenders: O(bad · log n) batch checks,
+                # so one client spraying garbage signatures cannot force
+                # per-item verification of every honest request
+                stack = [authed]
+                while stack:
+                    idxs = stack.pop()
+                    if len(idxs) == 1:
+                        i = idxs[0]
+                        if not ristretto.verify(*chunk[i][1]):
+                            rejected.add(i)
+                            chunk[i][2].set_exception(
+                                AuthFailure("bad challenge signature")
+                            )
+                        continue
+                    mid = len(idxs) // 2
+                    for half in (idxs[:mid], idxs[mid:]):
+                        if not ristretto.batch_verify(
+                            [chunk[i][1] for i in half]
+                        ):
+                            stack.append(half)
+
+            live = [
+                (req, fut)
+                for i, (req, _, fut) in enumerate(chunk)
+                if i not in rejected
+            ]
+            if not live:
+                continue
+            reqs = [r for r, _ in live]
             try:
                 resps = self.engine.handle_queries(reqs, self.clock())
-                for (_, fut), resp in zip(chunk, resps):
+                for (_, fut), resp in zip(live, resps):
                     fut.set_result(resp)
             except Exception as exc:  # pragma: no cover - defensive
-                for _, fut in chunk:
+                for _, fut in live:
                     if not fut.done():
                         fut.set_exception(exc)
 

@@ -34,7 +34,7 @@ from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire import protowire as pw
 from ..wire.records import QueryRequest
-from .scheduler import BatchScheduler
+from .scheduler import AuthFailure, BatchScheduler
 
 log = logging.getLogger("grapevine_tpu.server")
 
@@ -152,14 +152,20 @@ class GrapevineServer:
                 validate_request(req)
             except (ValueError, HardProtocolError) as exc:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-            if not ristretto.verify(
-                req.auth_identity,
-                C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
-                challenge,
-                req.auth_signature,
-            ):
+            # signature checked inside the round's batch verification
+            # (scheduler.py: one multi-scalar multiplication per round)
+            try:
+                resp = self.scheduler.submit(
+                    req,
+                    auth=(
+                        req.auth_identity,
+                        C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
+                        challenge,
+                        req.auth_signature,
+                    ),
+                )
+            except AuthFailure:
                 context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad challenge signature")
-            resp = self.scheduler.submit(req)
             ciphertext = session.channel.encrypt(resp.pack())
         return pw.encode_envelope(pw.EnvelopeMessage(data=ciphertext))
 

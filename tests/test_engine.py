@@ -17,7 +17,7 @@ from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
 NOW = 1_700_000_000
 
-SMALL = GrapevineConfig(
+SMALL = GrapevineConfig(bucket_cipher_rounds=0, 
     max_messages=64,
     max_recipients=8,
     mailbox_cap=4,
@@ -117,7 +117,7 @@ def test_engine_matches_oracle_random_ops():
 
 
 def test_mailbox_cap_and_capacity_reuse():
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=8, max_recipients=4, mailbox_cap=3, batch_size=4, stash_size=64, commit="op"
     )
     engine = GrapevineEngine(cfg, seed=5)
@@ -211,7 +211,7 @@ def test_delete_with_half_guessed_id_mutates_nothing():
 
 
 def test_expiry_sweep_engine_vs_oracle():
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=32, max_recipients=8, mailbox_cap=4, batch_size=4,
         stash_size=64, expiry_period=100, commit="op",
     )
@@ -247,7 +247,7 @@ def test_expiry_sweep_engine_vs_oracle():
 def test_expiry_clock_regression_keeps_future_records():
     """Regression: a sweep clock behind a record's timestamp must not
     mass-evict via u32 wraparound (oracle uses signed comparison)."""
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=16, max_recipients=4, mailbox_cap=4, batch_size=2,
         stash_size=64, expiry_period=100, commit="op",
     )

@@ -1,0 +1,82 @@
+"""Native ristretto255 library ≡ the pure-Python implementation.
+
+The C library (grapevine_tpu/native/r255.c) is verification-speed
+infrastructure; the pure-Python RFC 9496 implementation (vector-tested in
+test_session.py) is its correctness oracle. Skipped entirely when no C
+compiler is available (the package degrades to pure Python)."""
+
+import os
+import random
+
+import pytest
+
+from grapevine_tpu import native
+from grapevine_tpu.session import ristretto as R
+
+pytestmark = pytest.mark.skipif(
+    native.lib is None, reason="no C compiler; pure-Python fallback in use"
+)
+
+rng = random.Random(1234)
+
+
+def test_point_encode_decode_roundtrip_matches_python():
+    for _ in range(64):
+        k = rng.randrange(1, R.L)
+        enc = (k * R.BASEPOINT).encode()
+        assert native.reencode(enc) == enc
+
+
+def test_decode_validity_agrees_with_python():
+    cases = [
+        b"\x00" * 32,  # identity: valid
+        b"\x01" + b"\x00" * 31,
+        b"\xff" * 32,
+        (R.P - 1).to_bytes(32, "little"),
+        (R.P).to_bytes(32, "little"),
+    ] + [os.urandom(32) for _ in range(64)]
+    for enc in cases:
+        py_ok = True
+        try:
+            R.RistrettoPoint.decode(enc)
+        except ValueError:
+            py_ok = False
+        assert (native.reencode(enc) is not None) == py_ok, enc.hex()
+
+
+def test_verify_and_batch_agree_with_python_paths():
+    items = []
+    for i in range(12):
+        sk, pub = R.keygen(bytes([i + 1]) * 32)
+        msg = bytes([i]) * 32
+        sig = R.sign(sk, b"ctx", msg)
+        items.append((pub, b"ctx", msg, sig))
+    # public API (native-dispatching) accepts all
+    for it in items:
+        assert R.verify(*it)
+    assert R.batch_verify(items)
+    # pure-python check of the same signatures (oracle agreement)
+    for pub, ctx, msg, sig in items:
+        s = int.from_bytes(sig[32:], "little")
+        k = R._h_scalar(R._CHAL_DOMAIN, ctx, sig[:32], pub, msg)
+        big_r = R.RistrettoPoint.decode(sig[:32])
+        a_pt = R.RistrettoPoint.decode(pub)
+        assert R._fixed_base_mult(s) == (big_r + k * a_pt)
+    # tampering caught by both
+    pub, ctx, msg, sig = items[3]
+    bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+    assert not R.verify(pub, ctx, msg, bad)
+    bad_batch = list(items)
+    bad_batch[3] = (pub, ctx, msg, bad)
+    assert not R.batch_verify(bad_batch)
+
+
+def test_malformed_inputs_return_invalid_not_crash():
+    assert not R.verify(b"\x00" * 32, b"c", b"m", b"\xff" * 64)
+    assert not R.verify(b"\xff" * 32, b"c", b"m", b"\x00" * 64)
+    assert not R.batch_verify([(b"\xff" * 32, b"c", b"m" * 8, b"\x00" * 64)])
+    # scalar ≥ L rejected
+    sk, pub = R.keygen(b"q" * 32)
+    sig = R.sign(sk, b"c", b"m" * 8)
+    big_s = sig[:32] + (R.L).to_bytes(32, "little")
+    assert not R.verify(pub, b"c", b"m" * 8, big_s)

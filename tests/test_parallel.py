@@ -8,6 +8,7 @@ SGX, reference .github/workflows/ci.yaml:15-16).
 
 import numpy as np
 import jax
+import pytest
 
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import pack_batch
@@ -19,13 +20,15 @@ from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
 NOW = 1_700_000_000
 
-CFG = GrapevineConfig(
-    max_messages=64,
-    max_recipients=8,
-    mailbox_cap=4,
-    batch_size=4,
-    stash_size=64,
-)
+def make_cfg(cipher_rounds: int) -> GrapevineConfig:
+    return GrapevineConfig(
+        max_messages=64,
+        max_recipients=8,
+        mailbox_cap=4,
+        batch_size=4,
+        stash_size=64,
+        bucket_cipher_rounds=cipher_rounds,
+    )
 
 
 def key(n: int) -> bytes:
@@ -45,14 +48,20 @@ def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
     )
 
 
-def test_sharded_step_matches_single_chip():
+@pytest.mark.parametrize(
+    "cipher_rounds,n_dev", [(0, 8), (8, 8), (0, 2), (8, 4)]
+)
+def test_sharded_step_matches_single_chip(cipher_rounds, n_dev):
+    """Sharded ≡ single-chip at 2/4/8-way meshes, with the at-rest
+    bucket cipher both off and on (the cipher's nonce arrays are sharded
+    along the bucket axis like the trees)."""
     assert len(jax.devices()) >= 8, "conftest forces an 8-device CPU mesh"
-    ecfg = EngineConfig.from_config(CFG)
+    ecfg = EngineConfig.from_config(make_cfg(cipher_rounds))
 
     state = init_engine(ecfg, seed=3)
     single = jax.jit(engine_round_step, static_argnums=(0,))
 
-    mesh = make_mesh(jax.devices()[:8])
+    mesh = make_mesh(jax.devices()[:n_dev])
     sstate = shard_engine_state(init_engine(ecfg, seed=3), mesh)
     sstep = make_sharded_step(ecfg, mesh)
 

@@ -80,7 +80,7 @@ def test_engine_id_word0_fresh_across_block_reuse():
     from grapevine_tpu.wire import constants as C
     from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=64, max_recipients=8, mailbox_cap=4, batch_size=2
     )
     engine = GrapevineEngine(cfg, seed=2)
@@ -137,7 +137,7 @@ def test_engine_ids_do_not_reveal_allocation_order():
     from grapevine_tpu.wire import constants as C
     from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=256, max_recipients=8, mailbox_cap=8, batch_size=4
     )
     engine = GrapevineEngine(cfg, seed=1)

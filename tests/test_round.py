@@ -188,7 +188,7 @@ def test_occurrence_masks():
 
 # ---- phase-major engine vs oracle -------------------------------------
 
-SMALL = GrapevineConfig(
+SMALL = GrapevineConfig(bucket_cipher_rounds=0, 
     max_messages=64,
     max_recipients=8,
     mailbox_cap=4,
@@ -226,16 +226,30 @@ def assert_responses_equal(dev, ora, ctx=""):
 def test_round_engine_matches_batch_oracle():
     """Random multi-op batches (with same-key hazards): round engine must
     agree with the oracle's phase-major handle_batch on everything."""
-    engine = GrapevineEngine(SMALL, seed=3)
-    oracle = ReferenceEngine(config=SMALL, rng=random.Random(99))
+    _run_engine_vs_oracle(SMALL, n_steps=30)
+
+
+def test_round_engine_matches_batch_oracle_with_bucket_cipher():
+    """Same harness with the at-rest bucket cipher enabled (the shipped
+    default): randomized CRUD through encrypted trees must stay
+    oracle-identical."""
+    import dataclasses
+
+    cfg = dataclasses.replace(SMALL, bucket_cipher_rounds=8)
+    _run_engine_vs_oracle(cfg, n_steps=10)
+
+
+def _run_engine_vs_oracle(cfg, n_steps):
+    engine = GrapevineEngine(cfg, seed=3)
+    oracle = ReferenceEngine(config=cfg, rng=random.Random(99))
     rng = random.Random(1234)
     idents = [key(i + 1) for i in range(5)]
     live_ids: list[tuple[bytes, bytes, bytes]] = []
 
     t = NOW
-    for step_no in range(30):
+    for step_no in range(n_steps):
         t += rng.randrange(3)
-        n_ops = rng.randrange(1, SMALL.batch_size + 1)
+        n_ops = rng.randrange(1, cfg.batch_size + 1)
         reqs = []
         for _ in range(n_ops):
             c = rng.random()
@@ -283,7 +297,7 @@ def test_round_engine_matches_batch_oracle():
 def test_round_engine_single_op_matches_per_op_oracle():
     """For single-op batches, phase-major ≡ per-op semantics — the oracle's
     plain handle_query is the yardstick."""
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=16, max_recipients=4, mailbox_cap=3, batch_size=1, stash_size=96
     )
     engine = GrapevineEngine(cfg, seed=8)
@@ -372,7 +386,7 @@ def test_phase_major_divergence_is_as_documented():
     """The one visible batch hazard: a CREATE cannot reuse a record slot
     freed by an explicit DELETE in the same batch (TOO_MANY_MESSAGES),
     but can in the next batch — and the oracle agrees."""
-    cfg = GrapevineConfig(
+    cfg = GrapevineConfig(bucket_cipher_rounds=0, 
         max_messages=2, max_recipients=4, mailbox_cap=2, batch_size=4, stash_size=96
     )
     engine = GrapevineEngine(cfg, seed=2)

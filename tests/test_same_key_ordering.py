@@ -28,7 +28,7 @@ from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
 NOW = 1_700_000_000
 
-CFG = GrapevineConfig(
+CFG = GrapevineConfig(bucket_cipher_rounds=0, 
     max_messages=64,
     max_recipients=8,
     mailbox_cap=4,

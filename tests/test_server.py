@@ -12,7 +12,7 @@ from grapevine_tpu.server.service import GrapevineServer
 from grapevine_tpu.server.uri import GrapevineUri
 from grapevine_tpu.wire import constants as C
 
-CFG = GrapevineConfig(
+CFG = GrapevineConfig(bucket_cipher_rounds=0, 
     max_messages=64, max_recipients=8, mailbox_cap=8, batch_size=4, stash_size=64
 )
 
@@ -183,3 +183,69 @@ def test_session_eviction_cap():
             c.close()
     finally:
         srv.stop()
+
+
+def test_scheduler_bisection_rejects_only_bad_signatures():
+    """A round mixing valid and garbage signatures must reject exactly
+    the garbage (via batch bisection) and serve the rest."""
+    import threading
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.server.scheduler import AuthFailure, BatchScheduler
+    from grapevine_tpu.session import ristretto
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=64,
+        max_recipients=8,
+        mailbox_cap=4,
+        batch_size=8,
+    )
+    engine = GrapevineEngine(cfg, seed=21)
+    sched = BatchScheduler(engine, max_wait_ms=50.0)
+    try:
+        results: dict[int, object] = {}
+
+        def submit(i, good):
+            sk, pub = ristretto.keygen(bytes([i + 1]) * 32)
+            msg = bytes([i]) * 32
+            sig = (
+                ristretto.sign(sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, msg)
+                if good
+                else b"\x42" * 64
+            )
+            req = QueryRequest(
+                request_type=C.REQUEST_TYPE_CREATE,
+                auth_identity=pub,
+                auth_signature=sig,
+                record=RequestRecord(
+                    msg_id=C.ZERO_MSG_ID,
+                    recipient=pub,
+                    payload=bytes([i]) * C.PAYLOAD_SIZE,
+                ),
+            )
+            auth = (pub, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, msg, sig)
+            try:
+                results[i] = sched.submit(req, auth=auth)
+            except AuthFailure as e:
+                results[i] = e
+
+        goods = {0, 2, 3, 5}
+        threads = [
+            threading.Thread(target=submit, args=(i, i in goods))
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(6):
+            if i in goods:
+                assert results[i].status_code == C.STATUS_CODE_SUCCESS, i
+            else:
+                assert isinstance(results[i], AuthFailure), i
+    finally:
+        sched.close()

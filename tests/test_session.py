@@ -116,3 +116,42 @@ def test_channel_handshake_and_framing():
     c4 = client_chan.encrypt(m2)
     with pytest.raises(Exception):
         server_chan.decrypt(c4)  # expects c3 first
+
+
+def test_batch_verify_accepts_valid_and_rejects_forgeries():
+    """Random-linear-combination batch verification (one multi-scalar
+    multiplication per engine round, SURVEY.md §2b 'consider batch
+    verify'): all-valid batches pass; any tampered item fails the batch."""
+    from grapevine_tpu.session import ristretto as R
+
+    items = []
+    for i in range(8):
+        sk, pub = R.keygen(bytes([i + 1]) * 32)
+        msg = bytes([i]) * 32
+        sig = R.sign(sk, b"ctx", msg)
+        assert R.verify(pub, b"ctx", msg, sig)
+        items.append((pub, b"ctx", msg, sig))
+    assert R.batch_verify(items)
+    assert R.batch_verify(items[:1])
+    assert R.batch_verify([])
+
+    flipped = list(items)
+    pub, ctx, msg, sig = flipped[3]
+    flipped[3] = (pub, ctx, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:])
+    assert not R.batch_verify(flipped)
+
+    wrong_msg = list(items)
+    pub, ctx, msg, sig = wrong_msg[5]
+    wrong_msg[5] = (pub, ctx, b"other" + msg[5:], sig)
+    assert not R.batch_verify(wrong_msg)
+
+    garbage = list(items)
+    garbage[0] = (b"\x00" * 32, b"ctx", b"m" * 32, b"\xff" * 64)
+    assert not R.batch_verify(garbage)
+
+
+def test_fixed_base_mult_matches_naive():
+    from grapevine_tpu.session import ristretto as R
+
+    for s in [1, 2, 7, R.L - 1, 0xDEADBEEF1234567890ABCDEF]:
+        assert R._fixed_base_mult(s) == (s * R.BASEPOINT)
