@@ -71,6 +71,12 @@ class GrapevineConfig:
             raise ValueError(
                 f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
             )
+        if self.max_messages & (self.max_messages - 1):
+            raise ValueError("max_messages must be a power of two")
+        if self.tree_density not in (1, 2, 4):
+            raise ValueError(
+                f"tree_density must be 1, 2, or 4, got {self.tree_density}"
+            )
     #: per-slot load target; table buckets M = ceil(
     #: max_recipients / (mailbox_slots * mailbox_load)).
     #:
@@ -97,10 +103,21 @@ class GrapevineConfig:
     #: failures; it costs a second path fetch per op. Planned.
     mailbox_load: float = 0.125
 
+    #: blocks per tree leaf for both ORAMs. The classic Path ORAM shape
+    #: is 1 (total slots = 8× blocks — 12.5% utilization); 2 halves tree
+    #: HBM per block and shortens every path by one level at a still-
+    #: conservative 25% utilization; 4 (50%) is the aggressive setting —
+    #: stash occupancy under density is exercised in tests/test_oram.py.
+    tree_density: int = 2
+
     @property
     def records_height(self) -> int:
-        """Tree height of the records ORAM: leaves = 2**height >= max_messages."""
-        return max(1, math.ceil(math.log2(self.max_messages)))
+        """Tree height of the records ORAM: leaves = blocks / density."""
+        return max(
+            1,
+            math.ceil(math.log2(self.max_messages))
+            - (self.tree_density.bit_length() - 1),
+        )
 
     @property
     def records_leaves(self) -> int:
@@ -117,7 +134,11 @@ class GrapevineConfig:
     @property
     def mailbox_height(self) -> int:
         """Tree height of the mailbox ORAM: block space = hash-table buckets."""
-        return max(1, math.ceil(math.log2(self.mailbox_table_buckets)))
+        return max(
+            1,
+            math.ceil(math.log2(self.mailbox_table_buckets))
+            - (self.tree_density.bit_length() - 1),
+        )
 
     @property
     def mailbox_leaves(self) -> int:

@@ -10,14 +10,22 @@ Timestamps come from the untrusted host clock, as in the reference
 (README.md:92-97); a tampered clock can evict early/late but the sweep
 touches every bucket regardless, so it cannot reveal sender/recipient
 linkage.
+
+With the at-rest bucket cipher enabled, each tree is processed in row
+chunks under ``lax.scan``: decrypt chunk → expire → re-encrypt under the
+next epoch, all inside one scan body — at no point does more than one
+chunk of plaintext exist in HBM (a mid-sweep memory snapshot exposes at
+most ~8 M words, not the bus).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+from ..oblivious.bucket_cipher import epoch_next, row_keystream
 from ..oblivious.primitives import SENTINEL, is_zero_words
-from ..oram.path_oram import decrypt_tree, encrypt_tree
+from ..oram.path_oram import OramConfig, OramState
 from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS
 
 U32 = jnp.uint32
@@ -33,42 +41,97 @@ def _expired(ts: jnp.ndarray, now, period) -> jnp.ndarray:
     return (ts <= now) & ((now - ts) > period)
 
 
+def _chunk_rows(cfg: OramConfig) -> int:
+    """Rows per scan chunk: power of two, ~8M words of keystream."""
+    n = cfg.n_buckets_padded
+    rpc = 1
+    while rpc * 2 <= n and rpc * 2 * cfg.row_words <= (1 << 23):
+        rpc *= 2
+    return rpc
+
+
+def _chunked_tree_sweep(cfg: OramConfig, oram: OramState, carry0, body):
+    """Run ``body(carry, (plaintext idx [rpc, Z], plaintext val
+    [rpc, Z*V])) -> (carry, (idx', val'))`` over the whole tree in
+    chunks, with per-chunk decrypt/re-encrypt when the cipher is on.
+    Returns (carry, OramState with new tree + nonces/epoch advanced)."""
+    z, v = cfg.bucket_slots, cfg.value_words
+    n = cfg.n_buckets_padded
+    rpc = _chunk_rows(cfg)
+    nch = n // rpc
+    bids = jnp.arange(n, dtype=U32).reshape(nch, rpc)
+    idx3 = oram.tree_idx.reshape(nch, rpc, z)
+    val3 = oram.tree_val.reshape(nch, rpc, z * v)
+    eps = oram.nonces.reshape(nch, rpc, 2)
+
+    def scan_body(carry, xs):
+        bid, ix, vl, ep = xs
+        if cfg.encrypted:
+            ks = row_keystream(
+                oram.cipher_key, bid, ep, cfg.row_words, cfg.cipher_rounds
+            )
+            ix = ix ^ ks[:, :z]
+            vl = vl ^ ks[:, z:]
+        carry, (ix, vl) = body(carry, (ix, vl))
+        if cfg.encrypted:
+            epn = jnp.broadcast_to(oram.epoch[None, :], (rpc, 2))
+            ks = row_keystream(
+                oram.cipher_key, bid, epn, cfg.row_words, cfg.cipher_rounds
+            )
+            ix = ix ^ ks[:, :z]
+            vl = vl ^ ks[:, z:]
+        return carry, (ix, vl)
+
+    carry, (idx_o, val_o) = jax.lax.scan(
+        scan_body, carry0, (bids, idx3, val3, eps)
+    )
+    new = oram._replace(
+        tree_idx=idx_o.reshape(-1), tree_val=val_o.reshape(n, z * v)
+    )
+    if cfg.encrypted:
+        new = new._replace(
+            nonces=jnp.broadcast_to(oram.epoch[None, :], oram.nonces.shape),
+            epoch=epoch_next(oram.epoch),
+        )
+    return carry, new
+
+
 def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineState:
     now = U32(now)
     period = U32(period)
 
-    # at-rest bucket cipher: the sweep is a whole-tree pass (uniform
-    # transcript), so decrypt both trees up front and re-encrypt them
-    # under a fresh epoch at the end (oram/path_oram.py helpers, chunked)
-    state = state._replace(
-        rec=decrypt_tree(ecfg.rec, state.rec),
-        mb=decrypt_tree(ecfg.mb, state.mb),
-    )
+    # --- records ORAM: invalidate expired blocks, gather liveness ------
+    rcfg = ecfg.rec
+    v = rcfg.value_words
+    n_msgs = ecfg.max_messages
 
-    # --- records ORAM: invalidate expired blocks -----------------------
-    def sweep_records(idx, ts):
-        live = idx != SENTINEL
+    def rec_body(present, xs):
+        ix, vl = xs  # [rpc, Z], [rpc, Z*V] plaintext
+        ts = vl[:, REC_TS::v][:, : rcfg.bucket_slots]
+        live = ix != SENTINEL
         dead = live & _expired(ts, now, period)
-        return jnp.where(dead, SENTINEL, idx)
+        ix = jnp.where(dead, SENTINEL, ix)
+        safe = jnp.where(ix != SENTINEL, ix, U32(n_msgs)).reshape(-1)
+        present = present.at[safe].set(True, mode="drop")
+        return present, (ix, vl)
 
-    rec = state.rec
-    z, v = ecfg.rec.bucket_slots, ecfg.rec.value_words
-    # tree_idx is flat [n*Z]; per-slot timestamps are a V-strided slice
-    # of the [n, Z*V] value rows — no relayout of the big array
-    rec_tree_idx = sweep_records(
-        rec.tree_idx.reshape(-1, z), rec.tree_val[:, REC_TS::v][:, :z]
-    )
-    rec_stash_idx = sweep_records(rec.stash_idx, rec.stash_val[:, REC_TS])
-    rec = rec._replace(
-        tree_idx=rec_tree_idx.reshape(-1), stash_idx=rec_stash_idx
-    )
+    present0 = jnp.zeros((n_msgs,), jnp.bool_)
+    present, rec = _chunked_tree_sweep(rcfg, state.rec, present0, rec_body)
+
+    # stash rows are plaintext private state
+    st_live = state.rec.stash_idx != SENTINEL
+    st_dead = st_live & _expired(state.rec.stash_val[:, REC_TS], now, period)
+    rec_stash_idx = jnp.where(st_dead, SENTINEL, state.rec.stash_idx)
+    safe = jnp.where(rec_stash_idx != SENTINEL, rec_stash_idx, U32(n_msgs))
+    present = present.at[safe].set(True, mode="drop")
+    rec = rec._replace(stash_idx=rec_stash_idx)
 
     # --- mailbox ORAM: clear expired entries, drop empty mailboxes -----
+    k, cap = ecfg.mb_slots, ecfg.mailbox_cap
+
     def sweep_mb(idx, val):
-        # idx: [...]; val: tree [n, Z*V] or stash [S, V] — one block per
-        # idx entry either way once flattened to rows of V words
+        # idx: [...]; val: blocks of V words — one block per idx entry
         lead = idx.shape
-        k, cap = ecfg.mb_slots, ecfg.mailbox_cap
         flat = val.reshape((-1, k * (8 + 4 * cap)))
         keys = flat.reshape(-1, k, 8 + 4 * cap)[:, :, :8]
         entries = flat.reshape(-1, k, 8 + 4 * cap)[:, :, 8:].reshape(-1, k, cap, 4)
@@ -87,42 +150,33 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
         new_idx = jnp.where(idx != SENTINEL, jnp.where(any_key, idx, SENTINEL), idx)
         return new_idx, out.reshape(val.shape), keys.reshape(lead + (k, 8))
 
-    mb = state.mb
-    zm = ecfg.mb.bucket_slots
-    mb_tree_idx, mb_tree_val, tree_keys = sweep_mb(
-        mb.tree_idx.reshape(-1, zm), mb.tree_val
-    )
-    mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(mb.stash_idx, mb.stash_val)
-    mb = mb._replace(
-        tree_idx=mb_tree_idx.reshape(-1),
-        tree_val=mb_tree_val,
-        stash_idx=mb_stash_idx,
-        stash_val=mb_stash_val,
-    )
-
-    # --- recount live recipients (keys survive only in live blocks) ----
     def live_keys(keys, idx):
         lead_live = idx != SENTINEL
         kv = ~is_zero_words(keys)
-        return jnp.sum(kv & lead_live[..., None])
+        return jnp.sum(kv & lead_live[..., None]).astype(U32)
 
-    recipients = (
-        live_keys(tree_keys, mb_tree_idx) + live_keys(stash_keys, mb_stash_idx)
-    ).astype(U32)
+    def mb_body(cnt, xs):
+        ix, vl = xs  # [rpc, Zm], [rpc, Zm*Vm] plaintext
+        new_idx, out_val, keys = sweep_mb(ix, vl)
+        return cnt + live_keys(keys, new_idx), (new_idx, out_val)
 
-    # --- rebuild the free-block list from surviving record indices -----
-    n = ecfg.max_messages
-    present = jnp.zeros((n,), jnp.bool_)
-    for idx in (rec_tree_idx.reshape(-1), rec_stash_idx.reshape(-1)):
-        safe = jnp.where(idx != SENTINEL, idx, n)  # OOB drops
-        present = present.at[safe].set(True, mode="drop")
+    recips, mb = _chunked_tree_sweep(
+        ecfg.mb, state.mb, jnp.zeros((), U32), mb_body
+    )
+    mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(
+        state.mb.stash_idx, state.mb.stash_val
+    )
+    recipients = recips + live_keys(stash_keys, mb_stash_idx)
+    mb = mb._replace(stash_idx=mb_stash_idx, stash_val=mb_stash_val)
+
+    # --- rebuild the free-block list from surviving record liveness ----
     order = jnp.argsort(present, stable=True)  # free (False) indices first
     freelist = order.astype(U32)
-    free_top = (n - jnp.sum(present)).astype(U32)
+    free_top = (U32(n_msgs) - jnp.sum(present.astype(U32))).astype(U32)
 
     return state._replace(
-        rec=encrypt_tree(ecfg.rec, rec),
-        mb=encrypt_tree(ecfg.mb, mb),
+        rec=rec,
+        mb=mb,
         freelist=freelist,
         free_top=free_top,
         recipients=recipients,

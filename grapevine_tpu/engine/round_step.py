@@ -145,13 +145,13 @@ def engine_round_step(
     create_ok = out_a["create_ok"]
     enc_w0 = jnp.where(id_zero, out_a["sel_blk"], msg_id[:, 0])
     enc_w1 = jnp.where(id_zero, out_a["sel_idw"], msg_id[:, 1])
-    dec_blk = prp2_decrypt(state.id_key, enc_w0, enc_w1, ecfg.rec.height)
+    dec_blk = prp2_decrypt(state.id_key, enc_w0, enc_w1, ecfg.id_bits)
     lookup_blk = jnp.where(create_ok, out_a["alloc_idx"], dec_blk)
     real_b = is_real & (
         create_ok | (~is_create & (~id_zero | out_a["sel_found"]))
     )
     idx_b = jnp.where(
-        real_b, lookup_blk & U32(ecfg.rec.leaves - 1), U32(ecfg.rec.dummy_index)
+        real_b, lookup_blk & U32(ecfg.rec.blocks - 1), U32(ecfg.rec.dummy_index)
     )
     ctx_b = {
         **ctx,

@@ -70,6 +70,11 @@ class EngineConfig:
     mb_table_buckets: int
     mb_slots: int  # K mailboxes per hash bucket
 
+    @property
+    def id_bits(self) -> int:
+        """PRP domain bits for msg-id word 0-1 (the block index space)."""
+        return max(1, self.max_messages.bit_length() - 1)
+
     @classmethod
     def from_config(cls, cfg: GrapevineConfig) -> "EngineConfig":
         m = cfg.mailbox_table_buckets
@@ -87,6 +92,7 @@ class EngineConfig:
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                n_blocks=cfg.max_messages,
             ),
             mb=OramConfig(
                 height=cfg.mailbox_height,
@@ -94,6 +100,7 @@ class EngineConfig:
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                n_blocks=m,
             ),
             mb_table_buckets=m,
             mb_slots=k,

@@ -291,7 +291,7 @@ def engine_step(
         alloc_idx = carry.freelist[alloc_pos]
         # id words 0-1 = PRP-encrypted (nonce, block index); word 3 odd
         # so a real id is never all-zeroes (oblivious/prp.py)
-        w0, w1 = prp2_encrypt(carry.id_key, alloc_idx, idr[0], ecfg.rec.height)
+        w0, w1 = prp2_encrypt(carry.id_key, alloc_idx, idr[0], ecfg.id_bits)
         new_id = jnp.stack([w0, w1, idr[1], idr[2] | 1])
 
         # operative mailbox key: the recipient for create / explicit-id ops,
@@ -337,14 +337,14 @@ def engine_step(
         lookup_blk = jnp.where(
             out_a["create_ok"],
             alloc_idx,
-            prp2_decrypt(carry.id_key, enc_w0, enc_w1, ecfg.rec.height),
+            prp2_decrypt(carry.id_key, enc_w0, enc_w1, ecfg.id_bits),
         )
         real_b = is_real & (
             out_a["create_ok"]
             | (~is_create & (~id_zero | out_a["sel_found"]))
         )
         idx_b = jnp.where(
-            real_b, lookup_blk & U32(ecfg.rec.leaves - 1), U32(ecfg.rec.dummy_index)
+            real_b, lookup_blk & U32(ecfg.rec.blocks - 1), U32(ecfg.rec.dummy_index)
         )
         rec1, out_b, leaf_b = oram_access(
             ecfg.rec,

@@ -336,7 +336,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # Word 3 is forced odd so a real id is never all-zeroes.
         idr = ctx["id_rand"]
         w0, w1 = prp2_encrypt(
-            ctx["id_key"], alloc_idx, idr[:, 0], ecfg.rec.height
+            ctx["id_key"], alloc_idx, idr[:, 0], ecfg.id_bits
         )
         new_id = jnp.stack([w0, w1, idr[:, 1], idr[:, 2] | U32(1)], axis=1)
 

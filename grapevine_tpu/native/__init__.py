@@ -28,8 +28,13 @@ lib = None
 
 
 def _build() -> Path | None:
-    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _SO
+    try:
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _SO
+    except OSError:
+        # a cached .so without the C source (or vice versa): use the .so
+        # if present, otherwise fall back to pure Python
+        return _SO if _SO.exists() else None
     # compile to a private temp file, then atomically rename: concurrent
     # importers (pytest workers, server + bench) must never dlopen a
     # half-written .so or have a mapped one rewritten under them

@@ -55,7 +55,7 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..oblivious.bucket_cipher import epoch_next, row_keystream
+from ..oblivious.bucket_cipher import epoch_next, row_keystream  # noqa: F401  (row_keystream used by cipher_rows)
 from ..oblivious.primitives import SENTINEL, first_true_onehot, onehot_select, rank_of
 
 U32 = jnp.uint32
@@ -83,15 +83,25 @@ def cipher_rows(
 
 @dataclasses.dataclass(frozen=True)
 class OramConfig:
-    """Static geometry (hashable: safe as a jit static argument)."""
+    """Static geometry (hashable: safe as a jit static argument).
 
-    height: int  # leaves = 2**height; block index space = [0, leaves)
+    The logical block-index space and the leaf space are decoupled:
+    ``blocks`` defaults to ``leaves`` (the classic ~12.5%-utilization
+    Path ORAM shape) but may exceed it — ``blocks = 2·leaves`` halves
+    tree HBM per block at 25% slot utilization (still conservative:
+    total slots = 8·leaves = 4·blocks), and shortens every path by one
+    level. Stash behavior at elevated density is covered by the
+    randomized density tests (tests/test_oram.py)."""
+
+    height: int  # leaves = 2**height
     value_words: int  # uint32 words per block value
     bucket_slots: int = 4  # Z
     stash_size: int = 96
     #: ChaCha rounds for at-rest bucket encryption; 0 disables the
     #: cipher (oblivious/bucket_cipher.py — the EPC-encryption analog)
     cipher_rounds: int = 0
+    #: logical block index space [0, n_blocks); None = leaves
+    n_blocks: int | None = None
 
     @property
     def encrypted(self) -> bool:
@@ -106,6 +116,10 @@ class OramConfig:
     @property
     def leaves(self) -> int:
         return 1 << self.height
+
+    @property
+    def blocks(self) -> int:
+        return self.n_blocks if self.n_blocks is not None else self.leaves
 
     @property
     def n_buckets(self) -> int:
@@ -129,7 +143,7 @@ class OramConfig:
     #: reserved block index used by dummy accesses; never stored in the tree
     @property
     def dummy_index(self) -> int:
-        return self.leaves
+        return self.blocks
 
 
 class OramState(NamedTuple):
@@ -150,7 +164,7 @@ class OramState(NamedTuple):
     tree_val: jax.Array  # u32[n_buckets, Z*V]; one row per bucket
     stash_idx: jax.Array  # u32[S]
     stash_val: jax.Array  # u32[S, V]
-    posmap: jax.Array  # u32[leaves + 1] (last entry backs the dummy index)
+    posmap: jax.Array  # u32[blocks + 1] (last entry backs the dummy index)
     overflow: jax.Array  # u32 scalar, sticky count of dropped blocks
     #: at-rest cipher state (zero-sized semantics when cfg.cipher_rounds
     #: == 0): per-bucket 64-bit write-epoch nonce (0 = never written ⇒
@@ -173,58 +187,12 @@ def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
         posmap=jax.random.randint(
-            k_pos, (cfg.leaves + 1,), 0, cfg.leaves, dtype=jnp.int32
+            k_pos, (cfg.blocks + 1,), 0, cfg.leaves, dtype=jnp.int32
         ).astype(U32),
         overflow=jnp.zeros((), U32),
         nonces=jnp.zeros((cfg.n_buckets_padded, 2), U32),
         cipher_key=jax.random.bits(k_cipher, (8,), U32),
         epoch=jnp.array([1, 0], U32),
-    )
-
-
-def _xor_tree(cfg: OramConfig, key: jax.Array, tree_idx, tree_val, epochs):
-    """XOR every bucket row with its keystream, chunked under lax.scan so
-    the full-tree keystream (GBs at 2^20+) never materializes."""
-    z, v = cfg.bucket_slots, cfg.value_words
-    n = cfg.n_buckets_padded
-    rpc = 1  # rows per chunk: power of two, ~8M words of keystream
-    while rpc * 2 <= n and rpc * 2 * cfg.row_words <= (1 << 23):
-        rpc *= 2
-    nch = n // rpc
-    bids = jnp.arange(n, dtype=U32).reshape(nch, rpc)
-    idx3 = tree_idx.reshape(nch, rpc, z)
-    val3 = tree_val.reshape(nch, rpc, z * v)
-    eps = epochs.reshape(nch, rpc, 2)
-
-    def body(_, xs):
-        bid, ix, vl, ep = xs
-        ks = row_keystream(key, bid, ep, cfg.row_words, cfg.cipher_rounds)
-        return None, (ix ^ ks[:, :z], vl ^ ks[:, z:])
-
-    _, (idx_o, val_o) = jax.lax.scan(body, None, (bids, idx3, val3, eps))
-    return idx_o.reshape(tree_idx.shape), val_o.reshape(tree_val.shape)
-
-
-def decrypt_tree(cfg: OramConfig, state: OramState) -> OramState:
-    """Full-tree decrypt to plaintext (nonces → 0). For whole-tree passes
-    (the expiry sweep); per-access work uses cipher_rows on paths."""
-    if not cfg.encrypted:
-        return state
-    idx, val = _xor_tree(cfg, state.cipher_key, state.tree_idx, state.tree_val, state.nonces)
-    return state._replace(
-        tree_idx=idx, tree_val=val, nonces=jnp.zeros_like(state.nonces)
-    )
-
-
-def encrypt_tree(cfg: OramConfig, state: OramState) -> OramState:
-    """Re-encrypt a plaintext tree under the next epoch (every bucket is
-    rewritten — a whole-tree pass is its own uniform transcript)."""
-    if not cfg.encrypted:
-        return state
-    eps = jnp.broadcast_to(state.epoch[None, :], state.nonces.shape)
-    idx, val = _xor_tree(cfg, state.cipher_key, state.tree_idx, state.tree_val, eps)
-    return state._replace(
-        tree_idx=idx, tree_val=val, nonces=eps, epoch=epoch_next(state.epoch)
     )
 
 
@@ -305,9 +273,9 @@ def working_leaves(
 ) -> jax.Array:
     """Leaf assignment for working-set entries from the private posmap.
 
-    SENTINEL/dummy slots read the throwaway posmap entry (cfg.leaves);
+    SENTINEL/dummy slots read the throwaway posmap entry (cfg.blocks);
     their value is never used (eviction masks invalid entries)."""
-    safe = jnp.where(idxs < U32(cfg.leaves), idxs, U32(cfg.leaves))
+    safe = jnp.where(idxs < U32(cfg.blocks), idxs, U32(cfg.blocks))
     return state_posmap[safe]
 
 

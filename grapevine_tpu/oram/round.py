@@ -142,8 +142,8 @@ def oram_round(
     first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
     # last occurrence wins the remap; others retarget the throwaway
-    # dummy-index slot (posmap[leaves] backs cfg.dummy_index)
-    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.leaves))
+    # dummy-index slot (posmap[blocks] backs cfg.dummy_index)
+    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.blocks))
     posmap = state.posmap.at[remap_tgt].set(new_leaves)
 
     path_b = jax.vmap(lambda lf: path_bucket_indices(cfg, lf))(leaves)  # [B,plen]

@@ -93,17 +93,18 @@ class BatchScheduler:
                 stack = [authed]
                 while stack:
                     idxs = stack.pop()
-                    if len(idxs) == 1:
-                        i = idxs[0]
-                        if not ristretto.verify(*chunk[i][1]):
-                            rejected.add(i)
-                            chunk[i][2].set_exception(
-                                AuthFailure("bad challenge signature")
-                            )
-                        continue
                     mid = len(idxs) // 2
                     for half in (idxs[:mid], idxs[mid:]):
-                        if not ristretto.batch_verify(
+                        if not half:
+                            continue
+                        if len(half) == 1:
+                            i = half[0]
+                            if not ristretto.verify(*chunk[i][1]):
+                                rejected.add(i)
+                                chunk[i][2].set_exception(
+                                    AuthFailure("bad challenge signature")
+                                )
+                        elif not ristretto.batch_verify(
                             [chunk[i][1] for i in half]
                         ):
                             stack.append(half)

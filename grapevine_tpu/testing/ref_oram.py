@@ -37,7 +37,7 @@ class RefPathOram:
             [_Slot() for _ in range(cfg.bucket_slots)] for _ in range(cfg.n_buckets)
         ]
         self.stash: list[_Slot] = [_Slot() for _ in range(cfg.stash_size)]
-        assert len(posmap_init) == cfg.leaves + 1
+        assert len(posmap_init) == cfg.blocks + 1
         self.posmap = list(posmap_init)
         self.overflow = 0
 

@@ -5,6 +5,7 @@ The test pyramid from SURVEY.md §4: (2) results equal a plain dict model;
 (3) public transcripts bit-identical to the scalar CPU reference.
 """
 
+import dataclasses
 import random
 
 import jax
@@ -61,11 +62,11 @@ def random_ops(seed, n_ops, cfg):
         choices = ["insert"]
         if live:
             choices += ["read", "read", "delete", "update"]
-        if len(live) >= cfg.leaves - 1:
+        if len(live) >= cfg.blocks - 1:
             choices = ["read", "read", "delete", "update"]
         c = rng.choice(choices)
         if c == "insert":
-            free = [i for i in range(cfg.leaves) if i not in live]
+            free = [i for i in range(cfg.blocks) if i not in live]
             idx = rng.choice(free)
             val = tuple(rng.getrandbits(32) for _ in range(cfg.value_words))
             live[idx] = val
@@ -77,7 +78,7 @@ def random_ops(seed, n_ops, cfg):
             ops.append((1, idx, val))
         elif c == "read":
             # mix of live reads and misses
-            idx = rng.choice(list(live)) if rng.random() < 0.8 else rng.randrange(cfg.leaves)
+            idx = rng.choice(list(live)) if rng.random() < 0.8 else rng.randrange(cfg.blocks)
             ops.append((0, idx, (0,) * cfg.value_words))
         else:
             idx = rng.choice(list(live))
@@ -87,17 +88,23 @@ def random_ops(seed, n_ops, cfg):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-def test_oram_matches_dict_model_and_mirror_transcript(seed):
+@pytest.mark.parametrize("density", [1, 2])
+def test_oram_matches_dict_model_and_mirror_transcript(seed, density):
     """One jitted scan over 300 random ops; bulk-compare every output with
-    the plain dict model and the scalar mirror (results AND transcript)."""
+    the plain dict model and the scalar mirror (results AND transcript).
+    Runs the classic blocks == leaves shape and the packed density-2
+    shape (the shipped default, config.tree_density)."""
+    cfg = CFG if density == 1 else dataclasses.replace(
+        CFG, n_blocks=CFG.leaves * 2
+    )
     key = jax.random.PRNGKey(seed)
-    state = init_oram(CFG, key)
-    mirror = RefPathOram(CFG, np.asarray(state.posmap).tolist())
+    state = init_oram(cfg, key)
+    mirror = RefPathOram(cfg, np.asarray(state.posmap).tolist())
 
     n_ops = 300
-    ops = random_ops(seed, n_ops, CFG)
+    ops = random_ops(seed, n_ops, cfg)
     leaf_rng = random.Random(1000 + seed)
-    new_leaves = [leaf_rng.randrange(CFG.leaves) for _ in range(n_ops)]
+    new_leaves = [leaf_rng.randrange(cfg.leaves) for _ in range(n_ops)]
 
     modes = np.array([m for m, _, _ in ops], np.uint32)
     idxs = np.array([i for _, i, _ in ops], np.uint32)
@@ -105,7 +112,7 @@ def test_oram_matches_dict_model_and_mirror_transcript(seed):
 
     batched = jax.jit(oram_access_batch, static_argnums=(0, 5))
     state, outs, leaves = batched(
-        CFG,
+        cfg,
         state,
         jnp.array(idxs),
         jnp.array(new_leaves, dtype=jnp.uint32),
@@ -237,3 +244,67 @@ def test_stash_bounded_under_load():
 
     # Z=4 Path ORAM stash stays far below the budget
     assert high_water < cfg.stash_size // 2, high_water
+
+
+def test_density_packed_tree_stash_behavior():
+    """blocks > leaves (tree_density 2 and 4): fill the ORAM to 90% of
+    the block space and hammer it with random batched rounds; results
+    stay correct (vs a dict model), nothing is dropped, and the stash
+    keeps headroom. This is the evidence behind config.tree_density."""
+    import numpy as np
+
+    from grapevine_tpu.oram.round import oram_round
+    from grapevine_tpu.oram.path_oram import stash_occupancy
+
+    for density in (2, 4):
+        cfg = OramConfig(
+            height=8, value_words=2, stash_size=160, n_blocks=(1 << 8) * density
+        )
+        key = jax.random.PRNGKey(density)
+        state = init_oram(cfg, key)
+        model = {}
+        rng = np.random.default_rng(density)
+        b = 16
+
+        def kv_apply(idxs, vals):
+            def apply_batch(vals0, present0):
+                # last write per key wins; write everything
+                return {}, vals, jnp.ones_like(present0)
+
+            return apply_batch
+
+        n_fill = int(0.9 * cfg.blocks)
+        live = rng.choice(cfg.blocks, size=n_fill, replace=False)
+        step = jax.jit(
+            lambda st, idxs, nl, dl, vals: oram_round(
+                cfg, st, idxs, nl, dl, kv_apply(idxs, vals), None
+            ),
+            static_argnums=(),
+        )
+        hw = 0
+        pos = 0
+        k2 = jax.random.PRNGKey(999)
+        while pos < n_fill:
+            chunk = live[pos : pos + b]
+            idxs = np.full((b,), cfg.dummy_index, np.uint32)
+            idxs[: len(chunk)] = chunk
+            vals = np.zeros((b, 2), np.uint32)
+            vals[: len(chunk), 0] = chunk
+            vals[: len(chunk), 1] = 1
+            k2, ka, kb = jax.random.split(k2, 3)
+            nl = jax.random.bits(ka, (b,), jnp.uint32) & jnp.uint32(cfg.leaves - 1)
+            dl = jax.random.bits(kb, (b,), jnp.uint32) & jnp.uint32(cfg.leaves - 1)
+            state, _, _ = step(state, jnp.asarray(idxs), nl, dl, jnp.asarray(vals))
+            for c in chunk:
+                model[int(c)] = 1
+            pos += b
+            hw = max(hw, int(stash_occupancy(state)))
+        assert int(state.overflow) == 0, f"density {density}: dropped blocks"
+        assert hw < cfg.stash_size // 2, (
+            f"density {density}: stash high-water {hw}/{cfg.stash_size}"
+        )
+        # every live block is where the posmap says (full sweep readback)
+        occupied = int(
+            jnp.sum(state.tree_idx != jnp.uint32(0xFFFFFFFF))
+        ) + int(jnp.sum(state.stash_idx != jnp.uint32(0xFFFFFFFF)))
+        assert occupied == len(model)

@@ -165,10 +165,10 @@ def test_too_many_recipients():
 
 def test_too_many_messages():
     eng = ReferenceEngine(
-        config=GrapevineConfig(max_messages=3, max_recipients=8, mailbox_cap=62),
+        config=GrapevineConfig(max_messages=4, max_recipients=8, mailbox_cap=62),
         rng=random.Random(1),
     )
-    for i in range(3):
+    for i in range(4):
         assert create(eng, key(1), key(2 + i)).status_code == C.STATUS_CODE_SUCCESS
     assert create(eng, key(1), key(7)).status_code == C.STATUS_CODE_TOO_MANY_MESSAGES
 
