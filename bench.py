@@ -147,7 +147,7 @@ def bench_crd_loop(smoke):
 
 def bench_batched_read(smoke):
     """Config 2: B concurrent explicit-id reads at 2^20."""
-    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 1024, 12)
+    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 2048, 12)
     cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, batch)
     rng = np.random.default_rng(5)
     n_live = batch
@@ -175,7 +175,7 @@ def bench_batched_read(smoke):
 def bench_zipf_mixed(smoke):
     """Config 3: mixed CRUD, Zipf(1.1) recipients — hammers hot
     mailboxes into the 62-message cap."""
-    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 1024, 12)
+    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 2048, 12)
     cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, batch)
     rng = np.random.default_rng(11)
     n_id = 512

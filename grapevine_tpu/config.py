@@ -71,8 +71,8 @@ class GrapevineConfig:
             raise ValueError(
                 f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
             )
-        if self.max_messages & (self.max_messages - 1):
-            raise ValueError("max_messages must be a power of two")
+        if self.max_messages < 2 or self.max_messages & (self.max_messages - 1):
+            raise ValueError("max_messages must be a power of two >= 2")
         if self.tree_density not in (1, 2, 4):
             raise ValueError(
                 f"tree_density must be 1, 2, or 4, got {self.tree_density}"

@@ -126,7 +126,7 @@ def epoch_next(epoch: jax.Array) -> jax.Array:
 
 
 # NOTE: whole-tree passes (the expiry sweep) decrypt/re-encrypt entire
-# rows via oram/path_oram.py:decrypt_tree/encrypt_tree; there is no
-# partial-word decrypt API on purpose — CTR-mode random access would
+# rows chunk-by-chunk via engine/expiry.py:_chunked_tree_sweep; there is
+# no partial-word decrypt API on purpose — CTR-mode random access would
 # permit one, but nothing uses it and the sweep's cost model is the
 # full-row recrypt documented there.

@@ -251,7 +251,6 @@ def test_density_packed_tree_stash_behavior():
     the block space and hammer it with random batched rounds; results
     stay correct (vs a dict model), nothing is dropped, and the stash
     keeps headroom. This is the evidence behind config.tree_density."""
-    import numpy as np
 
     from grapevine_tpu.oram.round import oram_round
     from grapevine_tpu.oram.path_oram import stash_occupancy
