@@ -15,6 +15,7 @@ reference rejects them before the oblivious path.
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -32,6 +33,7 @@ from .state import (
     PAYLOAD_WORDS,
     init_engine,
 )
+from .metrics import EngineMetrics
 from .round_step import engine_round_step
 from .step import engine_step
 
@@ -117,6 +119,7 @@ class GrapevineEngine:
         self._step = jax.jit(step_fn, static_argnums=(0,))
         self._sweep = jax.jit(expiry_sweep, static_argnums=(0,))
         self._lock = threading.Lock()
+        self.metrics = EngineMetrics()
 
     def handle_queries(
         self, reqs: list[QueryRequest], now: int
@@ -132,8 +135,12 @@ class GrapevineEngine:
             for i in range(0, len(reqs), bs):
                 chunk = reqs[i : i + bs]
                 batch = pack_batch(chunk, bs, now)
+                t0 = time.perf_counter()
                 self.state, resp, _ = self._step(self.ecfg, self.state, batch)
                 out.extend(unpack_responses(resp, len(chunk)))
+                self.metrics.record_round(
+                    len(chunk), bs, time.perf_counter() - t0
+                )
         return out
 
     def handle_queries_with_transcript(self, reqs, now):
@@ -161,7 +168,9 @@ class GrapevineEngine:
                 np.uint32(min(int(now), 0xFFFFFFFF)),
                 np.uint32(period),
             )
-            return int(self.state.free_top) - before
+            evicted = int(self.state.free_top) - before
+            self.metrics.record_sweep(evicted)
+            return evicted
 
     # -- metrics (never keyed by client identity; SURVEY.md §5) ---------
 
@@ -172,8 +181,20 @@ class GrapevineEngine:
         return int(self.state.recipients)
 
     def health(self) -> dict:
-        return {
-            "messages": self.message_count(),
-            "recipients": self.recipient_count(),
-            "stash_overflow": int(self.state.rec.overflow) + int(self.state.mb.overflow),
-        }
+        """Aggregate state + batch-level counters (never per-client).
+
+        Stash occupancy is sampled here rather than per round: a device
+        reduction every round would serialize the dispatch pipeline for
+        a gauge that is only read at scrape time."""
+        from ..oram.path_oram import stash_occupancy
+
+        with self._lock:
+            state = self.state  # one round's state for a consistent snapshot
+            for tree in (state.rec, state.mb):
+                self.metrics.observe_stash(int(stash_occupancy(tree)))
+            return {
+                "messages": self.ecfg.max_messages - int(state.free_top),
+                "recipients": int(state.recipients),
+                "stash_overflow": int(state.rec.overflow) + int(state.mb.overflow),
+                **self.metrics.snapshot(),
+            }

@@ -1,0 +1,88 @@
+"""Batch-level engine metrics (SURVEY §5 observability).
+
+The obliviousness requirement constrains telemetry: nothing here is
+keyed by client identity or op type — per-op timing/type breakdowns
+would themselves be the side channel the engine exists to close
+(reference grapevine.proto:120-122). What IS safe to export, and what
+operators need (the reference's `mc-common` logging analog):
+
+- round counters: rounds run, real ops, padded slots → batch occupancy;
+- round latency: a fixed-size ring of recent wall times → p50/p99
+  (BASELINE.json tracks p99 access latency as a first-class metric);
+- expiry sweeps run and records evicted;
+- auth: batch verifications, failed signatures (counts only);
+- stash pressure: sampled occupancy high-water mark per tree (polled at
+  ``snapshot()`` — a per-round device reduction would stall the
+  dispatch pipeline for a gauge nobody reads between scrapes).
+
+Thread-safety: counters are guarded by one lock; `record_round` is
+called with the engine lock already held (the engine serializes rounds),
+so contention is nil.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class EngineMetrics:
+    """Monotonic counters + a latency ring; `snapshot()` is the export."""
+
+    def __init__(self, ring_size: int = 1024):
+        self._lock = threading.Lock()
+        self._ring = np.zeros((ring_size,), np.float64)
+        self._ring_n = 0  # total rounds ever recorded
+        self.real_ops = 0
+        self.padded_slots = 0
+        self.sweeps = 0
+        self.evicted = 0
+        self.batch_verifies = 0
+        self.auth_failures = 0
+        self.stash_high_water = 0
+
+    # -- recording ------------------------------------------------------
+
+    def record_round(self, n_real: int, batch_size: int, seconds: float) -> None:
+        with self._lock:
+            self._ring[self._ring_n % self._ring.size] = seconds
+            self._ring_n += 1
+            self.real_ops += n_real
+            self.padded_slots += batch_size - n_real
+
+    def record_sweep(self, evicted: int) -> None:
+        with self._lock:
+            self.sweeps += 1
+            self.evicted += evicted
+
+    def record_auth(self, failures: int = 0) -> None:
+        with self._lock:
+            self.batch_verifies += 1
+            self.auth_failures += failures
+
+    def observe_stash(self, occupancy: int) -> None:
+        with self._lock:
+            self.stash_high_water = max(self.stash_high_water, occupancy)
+
+    # -- export ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            rounds = self._ring_n
+            lat = self._ring[: min(rounds, self._ring.size)]
+            slots = self.real_ops + self.padded_slots
+            out = {
+                "rounds": rounds,
+                "real_ops": self.real_ops,
+                "batch_occupancy": (self.real_ops / slots) if slots else 0.0,
+                "sweeps": self.sweeps,
+                "evicted": self.evicted,
+                "batch_verifies": self.batch_verifies,
+                "auth_failures": self.auth_failures,
+                "stash_high_water": self.stash_high_water,
+            }
+            if len(lat):
+                out["round_ms_p50"] = round(float(np.percentile(lat, 50)) * 1e3, 3)
+                out["round_ms_p99"] = round(float(np.percentile(lat, 99)) * 1e3, 3)
+        return out

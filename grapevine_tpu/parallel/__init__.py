@@ -10,6 +10,7 @@ capacity grows with pod HBM (SURVEY.md §2c, BASELINE config 5).
 from .mesh import (
     TREE_AXIS,
     engine_state_specs,
+    init_sharded_engine,
     make_mesh,
     make_sharded_step,
     shard_engine_state,
@@ -18,6 +19,7 @@ from .mesh import (
 __all__ = [
     "TREE_AXIS",
     "engine_state_specs",
+    "init_sharded_engine",
     "make_mesh",
     "make_sharded_step",
     "shard_engine_state",

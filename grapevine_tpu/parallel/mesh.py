@@ -88,6 +88,27 @@ def shard_engine_state(state: EngineState, mesh: Mesh) -> EngineState:
     )
 
 
+def init_sharded_engine(ecfg: EngineConfig, mesh: Mesh, seed: int = 0) -> EngineState:
+    """Initialize engine state *directly* sharded over the mesh.
+
+    ``init_engine`` + ``shard_engine_state`` stages the full state on one
+    device before copying shard-wise — impossible at pod scale (a 2^24
+    bus is a 32 GB records tree; one v5e chip holds 16 GB) and a 2×
+    host-memory spike in simulation. Jitting the initializer with
+    ``out_shardings`` lets XLA materialize each shard on its owner
+    device only, so peak memory is the sharded footprint itself."""
+    from ..engine.state import init_engine
+
+    specs = engine_state_specs()
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+    return jax.jit(
+        lambda: init_engine(ecfg, seed), out_shardings=shardings
+    )()
+
+
 def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     """Jit-compiled engine step with the bucket trees sharded over ``mesh``.
 

@@ -109,6 +109,8 @@ class BatchScheduler:
                         ):
                             stack.append(half)
 
+            if authed:
+                self.engine.metrics.record_auth(failures=len(rejected))
             live = [
                 (req, fut)
                 for i, (req, _, fut) in enumerate(chunk)

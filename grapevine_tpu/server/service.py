@@ -212,12 +212,22 @@ class GrapevineServer:
         log.info("grapevine-tpu serving on %s", uri)
         return port
 
+    def health(self) -> dict:
+        """Aggregate metrics (SURVEY §5: never keyed by client identity)."""
+        with self._sessions_lock:
+            n_sessions = len(self._sessions)
+        return {"sessions": n_sessions, **self.engine.health()}
+
     def _expiry_loop(self):
         interval = max(1.0, self.config.expiry_period / 10)
         while not self._expiry_stop.wait(interval):
             evicted = self.engine.expire(self.clock())
             if evicted:
                 log.info("expiry sweep evicted %d records", evicted)
+            # health() syncs the device (stash sampling) — only pay that
+            # when someone is listening at DEBUG
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug("health %s", self.health())
 
     def stop(self, grace: float = 1.0):
         self._expiry_stop.set()

@@ -1,0 +1,153 @@
+"""Capacity scaling evidence: 2^24 messages on a v5e-8 pod.
+
+BASELINE config 4 names a 2^24-capacity expiry sweep; one v5e chip has
+~16 GB HBM, and 2^24 1-KB records are 17 GB of raw payload — the target
+capacity is a *pod* configuration by construction, which is exactly the
+sharding story (SURVEY.md §2c: bucket-tree sharded across chips,
+BASELINE config 5). Evidence here comes in two tiers:
+
+- an always-run geometry test pinning the arithmetic: at 2^24 and tree
+  density 4 the records tree is 32 GB → 4 GB/chip on an 8-way mesh,
+  comfortably inside HBM next to the mailbox tree and position map; and
+  the per-chip shard equals the single-chip 2^21-at-density-2 tree that
+  the real-TPU bench does run (bench.py) — so the pod shape is the
+  benched shape, 8 times over;
+- a gated full-size test (GRAPEVINE_BIG_TESTS=1) that actually
+  instantiates the 2^24 engine sharded over the 8-device CPU mesh
+  (~32 GB host RAM), runs one batched CRUD round and one expiry sweep,
+  and checks consistency — the SGX_MODE=SW-style simulation of the pod
+  (reference .github/workflows/ci.yaml:15-16).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.state import EngineConfig
+
+V5E_HBM = 16 * 2**30
+MESH = 8
+
+
+def _tree_bytes(o) -> int:
+    """HBM bytes of one ORAM's device-resident arrays (tree + nonces)."""
+    z, v = o.bucket_slots, o.value_words
+    per_bucket = z * v * 4 + z * 4 + 8  # values + slot idx + nonce
+    return o.n_buckets_padded * per_bucket
+
+
+def pod_config() -> GrapevineConfig:
+    return GrapevineConfig(
+        max_messages=1 << 24,
+        max_recipients=1 << 14,
+        batch_size=1024,
+        stash_size=1024,
+        tree_density=4,
+    )
+
+
+def test_pod_capacity_geometry():
+    ecfg = EngineConfig.from_config(pod_config())
+    rec_b, mb_b = _tree_bytes(ecfg.rec), _tree_bytes(ecfg.mb)
+    # sharded axis 0 divides evenly across the mesh (n_buckets_padded is
+    # a power of two, path_oram.py:n_buckets_padded)
+    assert ecfg.rec.n_buckets_padded % MESH == 0
+    assert ecfg.mb.n_buckets_padded % MESH == 0
+    per_chip = (rec_b + mb_b) // MESH
+    # replicated state (posmap + freelist + stash) rides along on every chip
+    replicated = ecfg.rec.blocks * 4 * 2 + ecfg.mb.blocks * 4
+    assert per_chip + replicated < V5E_HBM // 2, (
+        f"per-chip {(per_chip + replicated) / 2**30:.1f} GB must leave "
+        "headroom for working buffers"
+    )
+    # the per-chip shard is the same tree the single-chip bench runs:
+    # 2^21 capacity at density 2 (bench.py expiry_sweep/batched_read)
+    single = EngineConfig.from_config(
+        GrapevineConfig(
+            max_messages=1 << 21,
+            max_recipients=1 << 14,
+            batch_size=1024,
+            stash_size=1024,
+            tree_density=2,
+        )
+    )
+    assert _tree_bytes(ecfg.rec) // MESH == _tree_bytes(single.rec) // 2
+    # capacity really is 2^24: enough tree slots for every message
+    assert ecfg.rec.n_buckets * ecfg.rec.bucket_slots >= 1 << 24
+
+
+def test_init_sharded_engine_matches_staged_init():
+    """Shard-aware init is bit-identical to init-then-shard (threefry is
+    deterministic under jit), at a shape small enough to stage both."""
+    import jax
+    import numpy as np
+
+    from grapevine_tpu.engine.state import init_engine
+    from grapevine_tpu.parallel import (
+        init_sharded_engine,
+        make_mesh,
+        shard_engine_state,
+    )
+
+    cfg = GrapevineConfig(
+        max_messages=256, max_recipients=32, mailbox_cap=4,
+        batch_size=4, stash_size=64,
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:MESH])
+    a = init_sharded_engine(ecfg, mesh, seed=7)
+    b = shard_engine_state(init_engine(ecfg, seed=7), mesh)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.skipif(
+    not os.environ.get("GRAPEVINE_BIG_TESTS"),
+    reason="32 GB instantiation; set GRAPEVINE_BIG_TESTS=1 to run",
+)
+def test_pod_2e24_round_and_sweep():
+    import jax
+
+    from grapevine_tpu.engine.expiry import expiry_sweep
+    from grapevine_tpu.parallel import (
+        init_sharded_engine,
+        make_mesh,
+        make_sharded_step,
+    )
+
+    assert len(jax.devices()) >= MESH
+    cfg = pod_config()
+    ecfg = EngineConfig.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:MESH])
+    # shard-aware init: the unsharded 32 GB state never exists anywhere
+    state = init_sharded_engine(ecfg, mesh, seed=0)
+    step = make_sharded_step(ecfg, mesh)
+
+    rng = np.random.default_rng(1)
+    b = cfg.batch_size
+    from grapevine_tpu.engine.state import ID_WORDS, KEY_WORDS, PAYLOAD_WORDS
+
+    batch = {
+        "req_type": np.ones((b,), np.uint32),  # all CREATEs
+        "auth": rng.integers(1, 2**31, (b, KEY_WORDS)).astype(np.uint32),
+        "msg_id": np.zeros((b, ID_WORDS), np.uint32),
+        "recipient": rng.integers(1, 2**31, (b, KEY_WORDS)).astype(np.uint32),
+        "payload": rng.integers(0, 2**31, (b, PAYLOAD_WORDS)).astype(np.uint32),
+        "now": np.uint32(1_700_000_000),
+    }
+    state, resp, transcripts = step(state, batch)
+    jax.block_until_ready(resp)
+    from grapevine_tpu.wire import constants as C
+
+    assert np.all(np.asarray(resp["status"]) == C.STATUS_CODE_SUCCESS)
+    assert int(np.asarray(state.rec.overflow)) == 0
+    assert np.asarray(transcripts).shape == (b, 3)
+
+    swept = jax.jit(expiry_sweep, static_argnums=(0,))(
+        ecfg, state, np.uint32(1_700_000_000 + 100), np.uint32(10)
+    )
+    jax.block_until_ready(swept.free_top)
+    # every live record was older than the period → all expired
+    assert int(np.asarray(swept.free_top)) == int(np.asarray(state.free_top)) + b

@@ -1,0 +1,73 @@
+"""Batch-level metrics (engine/metrics.py): counters, occupancy, p99."""
+
+import numpy as np
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.engine.metrics import EngineMetrics
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+
+def _req(rt, auth, recipient=C.ZERO_PUBKEY):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=C.ZERO_MSG_ID,
+            recipient=recipient,
+            payload=b"\x07" * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def test_metrics_ring_and_percentiles():
+    m = EngineMetrics(ring_size=8)
+    for i in range(20):  # wraps the ring
+        m.record_round(n_real=3, batch_size=4, seconds=0.001 * (i + 1))
+    m.record_sweep(5)
+    m.record_auth(failures=2)
+    m.observe_stash(17)
+    m.observe_stash(9)  # high-water keeps the max
+    s = m.snapshot()
+    assert s["rounds"] == 20
+    assert s["real_ops"] == 60
+    assert s["batch_occupancy"] == 0.75
+    assert s["sweeps"] == 1 and s["evicted"] == 5
+    assert s["batch_verifies"] == 1 and s["auth_failures"] == 2
+    assert s["stash_high_water"] == 17
+    # ring holds the last 8 rounds (13..20 ms)
+    assert 12.9 < s["round_ms_p50"] < 17.1
+    assert s["round_ms_p99"] <= 20.1
+
+
+def test_engine_health_includes_batch_metrics():
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=64,
+        max_recipients=16,
+        mailbox_cap=4,
+        batch_size=4,
+        stash_size=96,
+        expiry_period=10,
+    )
+    e = GrapevineEngine(cfg, seed=1)
+    a, b = bytes([1]) * 32, bytes([2]) * 32
+    resps = e.handle_queries(
+        [_req(C.REQUEST_TYPE_CREATE, a, recipient=b)] * 2, NOW
+    )
+    assert all(r.status_code == C.STATUS_CODE_SUCCESS for r in resps)
+    e.expire(NOW + 100)
+    h = e.health()
+    assert h["rounds"] == 1
+    assert h["real_ops"] == 2
+    assert h["batch_occupancy"] == 0.5  # 2 real ops in a 4-slot round
+    assert h["sweeps"] == 1 and h["evicted"] == 2
+    assert h["round_ms_p99"] > 0
+    # two live records were inserted then expired
+    assert h["messages"] == 0
+    assert h["stash_high_water"] >= 0
+    assert h["stash_overflow"] == 0
