@@ -59,6 +59,15 @@ def _load():
         handle = ctypes.CDLL(str(so))
     except OSError:
         return None
+    try:
+        return _bind(handle)
+    except AttributeError:
+        # a cached .so built from older source (missing a newer export):
+        # degrade to pure Python rather than failing the package import
+        return None
+
+
+def _bind(handle):
     handle.r255_init.restype = ctypes.c_int
     handle.r255_verify1.restype = ctypes.c_int
     handle.r255_verify1.argtypes = [ctypes.c_char_p] * 4
@@ -66,6 +75,8 @@ def _load():
     handle.r255_batch_check.argtypes = [ctypes.c_size_t] + [ctypes.c_char_p] * 5
     handle.r255_encode.restype = ctypes.c_int
     handle.r255_encode.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    handle.r255_mult_base.restype = ctypes.c_int
+    handle.r255_mult_base.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     if handle.r255_init() != 0:
         return None
     return handle
@@ -90,4 +101,15 @@ def reencode(enc: bytes) -> bytes | None:
     out = ctypes.create_string_buffer(32)
     with _lock:
         rc = lib.r255_encode(out, enc)
+    return bytes(out.raw) if rc == 0 else None
+
+
+def mult_base(scalar_le: bytes) -> bytes | None:
+    """Encoded ``scalar * basepoint`` (scalar: 32B LE, already reduced).
+
+    The client-side signing hot path (session/ristretto.py:sign does two
+    of these per request when cold, one when the pubkey is cached)."""
+    out = ctypes.create_string_buffer(32)
+    with _lock:
+        rc = lib.r255_mult_base(out, scalar_le)
     return bytes(out.raw) if rc == 0 else None

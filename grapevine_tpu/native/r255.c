@@ -359,11 +359,32 @@ int r255_init(void) {
     return 0;
 }
 
+/* constant-time select: r = table[d] scanned with masks, no secret-
+ * dependent branches or indices (the scalar is secret on the signing
+ * path — r255_mult_base computes nonce*B and key*B) */
+static void ge_ct_select(ge *r, const ge table[16], int d) {
+    const u64 *src0 = (const u64 *)&table[0];
+    u64 *dst = (u64 *)r;
+    size_t words = sizeof(ge) / sizeof(u64);
+    for (size_t i = 0; i < words; i++) dst[i] = src0[i];
+    for (int j = 1; j < 16; j++) {
+        u64 mask = (u64)0 - (u64)(((uint32_t)(j ^ d) - 1u) >> 31); /* all-1 iff j==d */
+        const u64 *src = (const u64 *)&table[j];
+        for (size_t i = 0; i < words; i++)
+            dst[i] ^= mask & (dst[i] ^ src[i]);
+    }
+}
+
 static void fixed_mult(ge *r, const uint8_t s[32]) {
-    ge_identity(r);
-    for (int w = 0; w < 64; w++) {
+    /* window 0 via select from identity-rooted table; remaining windows
+     * always add (Edwards unified addition is complete, so adding the
+     * selected entry — identity when the nibble is 0 — is safe) */
+    ge t;
+    ge_ct_select(r, FIXED_TABLE[0], s[0] & 0xF);
+    for (int w = 1; w < 64; w++) {
         int d = (s[w >> 1] >> ((w & 1) * 4)) & 0xF;
-        if (d) ge_add(r, r, &FIXED_TABLE[w][d]);
+        ge_ct_select(&t, FIXED_TABLE[w], d);
+        ge_add(r, r, &t);
     }
 }
 
@@ -434,12 +455,9 @@ int r255_batch_check(size_t n, const uint8_t *rs, const uint8_t *as_,
     return ristretto_eq(&left, &right);
 }
 
-/* test hooks: decode+re-encode (canonicality / round-trip checks) */
-int r255_encode(uint8_t out[32], const uint8_t in[32]) {
-    if (r255_init() != 0) return -1;
-    ge p;
-    if (ristretto_decode(&p, in) != 0) return -1;
-    /* RFC 9496 encode */
+/* RFC 9496 encode of an internal point */
+static void ristretto_encode_ge(uint8_t out[32], const ge *pp) {
+    ge p = *pp;
     fe u1, u2, t, den1, den2, z_inv, ix0, iy0, enchanted, x, y, den_inv, s_out;
     fe_add(&u1, &p.z, &p.y);
     fe_sub(&t, &p.z, &p.y); fe_carry(&t);
@@ -480,5 +498,22 @@ int r255_encode(uint8_t out[32], const uint8_t in[32]) {
     fe_mul(&s_out, &den_inv, &t);
     fe_cabs(&s_out, &s_out);
     fe_tobytes(out, &s_out);
+}
+
+/* test hook: decode+re-encode (canonicality / round-trip checks) */
+int r255_encode(uint8_t out[32], const uint8_t in[32]) {
+    if (r255_init() != 0) return -1;
+    ge p;
+    if (ristretto_decode(&p, in) != 0) return -1;
+    ristretto_encode_ge(out, &p);
+    return 0;
+}
+
+/* out = s*B (fixed-base, for client-side signing). 0 ok, -1 init fail */
+int r255_mult_base(uint8_t out[32], const uint8_t s[32]) {
+    if (r255_init() != 0) return -1;
+    ge p;
+    fixed_mult(&p, s);
+    ristretto_encode_ge(out, &p);
     return 0;
 }

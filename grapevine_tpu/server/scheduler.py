@@ -37,13 +37,16 @@ class BatchScheduler:
     def __init__(
         self,
         engine: GrapevineEngine,
-        max_wait_ms: float = 2.0,
+        max_wait_ms: float = 8.0,
+        idle_gap_ms: float = 2.0,
         clock=None,
     ):
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
+        self.idle_gap = idle_gap_ms / 1000.0
         self.clock = clock or (lambda: int(time.time()))
         self._queue: list[tuple[QueryRequest, AuthItem | None, Future]] = []
+        self._last_enqueue = 0.0
         self._cv = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(target=self._run, daemon=True)
@@ -62,6 +65,7 @@ class BatchScheduler:
             if self._closed:
                 raise RuntimeError("scheduler closed")
             self._queue.append((req, auth, fut))
+            self._last_enqueue = time.monotonic()
             self._cv.notify()
         return fut.result()
 
@@ -73,12 +77,23 @@ class BatchScheduler:
                     self._cv.wait()
                 if self._closed and not self._queue:
                     return
+                # Quiescence-based collection: a client wave re-arrives
+                # staggered over several ms after the previous round's
+                # responses land (decrypt → decode → sign → resubmit),
+                # so a fixed short window catches only the fastest few
+                # and halves effective occupancy (measured 26% at 8
+                # clients). Keep the window open while arrivals are
+                # still trickling in (inter-arrival gap < idle_gap),
+                # capped at max_wait total — under a steady concurrent
+                # load the round fills; a lone client still commits
+                # after idle_gap.
                 deadline = time.monotonic() + self.max_wait
                 while len(self._queue) < bs and not self._closed:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+                    now = time.monotonic()
+                    wait_until = min(deadline, self._last_enqueue + self.idle_gap)
+                    if now >= wait_until:
                         break
-                    self._cv.wait(timeout=remaining)
+                    self._cv.wait(timeout=wait_until - now)
                 chunk, self._queue = self._queue[:bs], self._queue[bs:]
 
             # --- one multi-scalar multiplication for the round --------

@@ -64,7 +64,7 @@ class GrapevineServer:
         self,
         config: GrapevineConfig | None = None,
         seed: int = 0,
-        max_wait_ms: float = 2.0,
+        max_wait_ms: float | None = None,
         attestation=None,
         clock=None,
         session_ttl: float = 3600.0,
@@ -72,7 +72,8 @@ class GrapevineServer:
     ):
         self.config = config or GrapevineConfig()
         self.engine = GrapevineEngine(self.config, seed=seed)
-        self.scheduler = BatchScheduler(self.engine, max_wait_ms=max_wait_ms, clock=clock)
+        sched_kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
+        self.scheduler = BatchScheduler(self.engine, clock=clock, **sched_kwargs)
         self.attestation = attestation or chan.NullAttestation()
         self._sessions: dict[bytes, _Session] = {}
         self._sessions_lock = threading.Lock()

@@ -188,11 +188,21 @@ def keygen(seed: bytes) -> tuple[bytes, bytes]:
     return a.to_bytes(32, "little"), pub
 
 
+def _mult_base_enc(scalar: int) -> bytes:
+    """Encoded ``scalar·B``, native when available (~0.05 ms vs ~2 ms
+    pure Python — the client-side signing hot path)."""
+    if _native.lib is not None:
+        enc = _native.mult_base((scalar % L).to_bytes(32, "little"))
+        if enc is not None:
+            return enc
+    return (scalar % L * BASEPOINT).encode()
+
+
 @functools.lru_cache(maxsize=4096)
 def public_key(sk: bytes) -> bytes:
     """sk bytes → encoded public point. LRU-cached: sign() is on the
     client per-request path and must not redo the basepoint mult."""
-    return (int.from_bytes(sk, "little") % L * BASEPOINT).encode()
+    return _mult_base_enc(int.from_bytes(sk, "little") % L)
 
 
 def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
@@ -204,7 +214,7 @@ def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
     r = _h_scalar(_NONCE_DOMAIN, sk, context, message)
     if r == 0:
         r = 1
-    big_r = (r * BASEPOINT).encode()
+    big_r = _mult_base_enc(r)
     k = _h_scalar(_CHAL_DOMAIN, context, big_r, pub, message)
     s = (r + k * a) % L
     return big_r + s.to_bytes(32, "little")

