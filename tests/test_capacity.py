@@ -62,18 +62,19 @@ def test_pod_capacity_geometry():
         f"per-chip {(per_chip + replicated) / 2**30:.1f} GB must leave "
         "headroom for working buffers"
     )
-    # the per-chip shard is the same tree the single-chip bench runs:
-    # 2^21 capacity at density 2 (bench.py expiry_sweep/batched_read)
+    # the per-chip shard is byte-for-byte the tree the single-chip bench
+    # runs: 2^20 capacity at density 2 (bench.py batched_read/zipf/expiry
+    # all use cap 2^20) — so the pod shape is the benched shape, 8×
     single = EngineConfig.from_config(
         GrapevineConfig(
-            max_messages=1 << 21,
+            max_messages=1 << 20,
             max_recipients=1 << 14,
             batch_size=1024,
             stash_size=1024,
             tree_density=2,
         )
     )
-    assert _tree_bytes(ecfg.rec) // MESH == _tree_bytes(single.rec) // 2
+    assert _tree_bytes(ecfg.rec) // MESH == _tree_bytes(single.rec)
     # capacity really is 2^24: enough tree slots for every message
     assert ecfg.rec.n_buckets * ecfg.rec.bucket_slots >= 1 << 24
 
