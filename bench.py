@@ -33,7 +33,7 @@ def _p99(times_s: list[float]) -> float:
     return float(np.percentile(np.asarray(times_s) * 1e3, 99))
 
 
-def _mk_engine(cap, recips, batch, stash=None, seed=0):
+def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
@@ -45,6 +45,7 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0):
         max_recipients=recips,
         batch_size=batch,
         stash_size=stash or max(128, batch // 2 + 96),
+        tree_density=density,
     )
     ecfg = EngineConfig.from_config(cfg)
     state = init_engine(ecfg, seed=seed)
@@ -247,13 +248,16 @@ def bench_zipf_mixed(smoke):
 
 def bench_expiry_sweep(smoke):
     """Config 4: full-bus timestamped eviction scan (reference
-    README.md:86-98) at the largest capacity that fits the chip."""
+    README.md:86-98) at the largest capacity that fits one chip:
+    2^22 messages at tree density 4 — an 8 GB records tree on a 16 GB
+    v5e, twice the 2^24 pod's 4 GB-per-chip shard (tests/
+    test_capacity.py pins that shard to the 2^20-density-2 tree)."""
     import jax
 
     from grapevine_tpu.engine.expiry import expiry_sweep
 
-    cap = (1 << 10) if smoke else (1 << 20)
-    cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, 64)
+    cap, density = ((1 << 10), 2) if smoke else ((1 << 22), 4)
+    cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, 64, density=density)
     # populate some traffic first so the sweep has work
     batches = make_batches(4, 64)
     for b in batches:
@@ -271,7 +275,7 @@ def bench_expiry_sweep(smoke):
     # records scanned per second over the full bus
     per = float(np.mean(times))
     return {"records_per_sec": round(cap / per, 1), "p99_sweep_ms": round(_p99(times), 2),
-            "capacity_log2": cap.bit_length() - 1}
+            "capacity_log2": cap.bit_length() - 1, "tree_density": density}
 
 
 def bench_sharded(smoke):

@@ -103,6 +103,29 @@ def unpack_responses(resp: dict, n: int) -> list[QueryResponse]:
     return out
 
 
+class PendingRound:
+    """Handle to a dispatched-but-unsynced round; ``resolve()`` blocks."""
+
+    __slots__ = ("_engine", "_resp", "_n", "_t0")
+
+    def __init__(self, engine, resp, n, t0):
+        self._engine = engine
+        self._resp = resp
+        self._n = n
+        self._t0 = t0
+
+    def resolve(self) -> list[QueryResponse]:
+        out = unpack_responses(self._resp, self._n)  # device sync happens here
+        # recorded duration = dispatch → results delivered. Under the
+        # pipelined scheduler this includes the next round's collection
+        # window (resolve runs after the next dispatch), i.e. it is the
+        # round *commit latency* a client observes, not pure device time
+        self._engine.metrics.record_round(
+            self._n, self._engine.ecfg.batch_size, time.perf_counter() - self._t0
+        )
+        return out
+
+
 class GrapevineEngine:
     """The in-process oblivious engine: the TPU analog of the enclave.
 
@@ -125,23 +148,37 @@ class GrapevineEngine:
         self, reqs: list[QueryRequest], now: int
     ) -> list[QueryResponse]:
         """Process requests in slot order (padding to full batches)."""
+        for r in reqs:  # all-or-nothing: nothing commits if any is malformed
+            validate_request(r)
+        out: list[QueryResponse] = []
+        bs = self.ecfg.batch_size
+        for i in range(0, len(reqs), bs):
+            out.extend(self.handle_queries_async(reqs[i : i + bs], now).resolve())
+        return out
+
+    def handle_queries_async(
+        self, reqs: list[QueryRequest], now: int
+    ) -> "PendingRound":
+        """Dispatch one round without waiting for the device.
+
+        JAX dispatch is asynchronous: this returns as soon as the round
+        is enqueued, so a caller (the scheduler) can collect and verify
+        the *next* round while the device executes this one — the
+        dispatch/compute overlap PERF.md's cost model calls for. Rounds
+        are serialized by the engine lock; ``resolve()`` blocks for the
+        results."""
         for r in reqs:
             validate_request(r)
         if int(now) <= 0:
             raise ValueError("server clock must be positive")
-        out: list[QueryResponse] = []
         bs = self.ecfg.batch_size
+        if len(reqs) > bs:
+            raise ValueError("async path is one round at a time")
         with self._lock:
-            for i in range(0, len(reqs), bs):
-                chunk = reqs[i : i + bs]
-                batch = pack_batch(chunk, bs, now)
-                t0 = time.perf_counter()
-                self.state, resp, _ = self._step(self.ecfg, self.state, batch)
-                out.extend(unpack_responses(resp, len(chunk)))
-                self.metrics.record_round(
-                    len(chunk), bs, time.perf_counter() - t0
-                )
-        return out
+            batch = pack_batch(reqs, bs, now)
+            t0 = time.perf_counter()
+            self.state, resp, _ = self._step(self.ecfg, self.state, batch)
+        return PendingRound(self, resp, len(reqs), t0)
 
     def handle_queries_with_transcript(self, reqs, now):
         """Test/bench variant returning the public transcript as well."""

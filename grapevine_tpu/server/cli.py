@@ -51,7 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--batch-size", type=int, default=8, help="ops per oblivious round")
     p.add_argument(
-        "--batch-wait-ms", type=float, default=2.0, help="max wait to fill a round"
+        "--batch-wait-ms",
+        type=float,
+        default=None,
+        help="cap on the round-collection window (default: scheduler's "
+        "quiescence policy, 8ms cap / 2ms idle gap)",
     )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument("-v", "--verbose", action="store_true")

@@ -71,77 +71,107 @@ class BatchScheduler:
 
     def _run(self):
         bs = self.engine.ecfg.batch_size
+        prev = None  # in-flight (PendingRound, live futures) — pipeline depth 1
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
+                    if prev is not None:
+                        break  # drain the in-flight round before sleeping
                     self._cv.wait()
-                if self._closed and not self._queue:
+                if self._closed and not self._queue and prev is None:
                     return
-                # Quiescence-based collection: a client wave re-arrives
-                # staggered over several ms after the previous round's
-                # responses land (decrypt → decode → sign → resubmit),
-                # so a fixed short window catches only the fastest few
-                # and halves effective occupancy (measured 26% at 8
-                # clients). Keep the window open while arrivals are
-                # still trickling in (inter-arrival gap < idle_gap),
-                # capped at max_wait total — under a steady concurrent
-                # load the round fills; a lone client still commits
-                # after idle_gap.
-                deadline = time.monotonic() + self.max_wait
-                while len(self._queue) < bs and not self._closed:
-                    now = time.monotonic()
-                    wait_until = min(deadline, self._last_enqueue + self.idle_gap)
-                    if now >= wait_until:
-                        break
-                    self._cv.wait(timeout=wait_until - now)
-                chunk, self._queue = self._queue[:bs], self._queue[bs:]
+                chunk = []
+                if self._queue:
+                    # Quiescence-based collection: a client wave
+                    # re-arrives staggered over several ms after the
+                    # previous round's responses land (decrypt → decode
+                    # → sign → resubmit), so a fixed short window caught
+                    # only the fastest few (measured 26% occupancy at 8
+                    # clients). Keep the window open while arrivals are
+                    # still trickling in (inter-arrival gap < idle_gap),
+                    # capped at max_wait total; a lone client still
+                    # commits after idle_gap. The wait runs while the
+                    # device executes the previous round (see below), so
+                    # it costs no device idle time under load.
+                    deadline = time.monotonic() + self.max_wait
+                    while len(self._queue) < bs and not self._closed:
+                        now = time.monotonic()
+                        wait_until = min(
+                            deadline, self._last_enqueue + self.idle_gap
+                        )
+                        if now >= wait_until:
+                            break
+                        self._cv.wait(timeout=wait_until - now)
+                    chunk, self._queue = self._queue[:bs], self._queue[bs:]
 
-            # --- one multi-scalar multiplication for the round --------
-            authed = [i for i, (_, a, _) in enumerate(chunk) if a is not None]
-            rejected: set[int] = set()
-            if authed and not ristretto.batch_verify(
-                [chunk[i][1] for i in authed]
-            ):
-                # bisect to the offenders: O(bad · log n) batch checks,
-                # so one client spraying garbage signatures cannot force
-                # per-item verification of every honest request
-                stack = [authed]
-                while stack:
-                    idxs = stack.pop()
-                    mid = len(idxs) // 2
-                    for half in (idxs[:mid], idxs[mid:]):
-                        if not half:
-                            continue
-                        if len(half) == 1:
-                            i = half[0]
-                            if not ristretto.verify(*chunk[i][1]):
-                                rejected.add(i)
-                                chunk[i][2].set_exception(
-                                    AuthFailure("bad challenge signature")
-                                )
-                        elif not ristretto.batch_verify(
-                            [chunk[i][1] for i in half]
-                        ):
-                            stack.append(half)
+            pending, live = (None, [])
+            if chunk:
+                live = self._verify_chunk(chunk)
+                if live:
+                    reqs = [r for r, _ in live]
+                    try:
+                        # async dispatch: the device starts this round
+                        # while we resolve the previous one and collect
+                        # the next — PERF.md's dispatch/compute overlap
+                        pending = self.engine.handle_queries_async(
+                            reqs, self.clock()
+                        )
+                    except Exception as exc:  # pragma: no cover - defensive
+                        for _, fut in live:
+                            if not fut.done():
+                                fut.set_exception(exc)
+                        live = []
+            if prev is not None:
+                self._settle(*prev)
+            prev = (pending, live) if pending is not None else None
 
-            if authed:
-                self.engine.metrics.record_auth(failures=len(rejected))
-            live = [
-                (req, fut)
-                for i, (req, _, fut) in enumerate(chunk)
-                if i not in rejected
-            ]
-            if not live:
-                continue
-            reqs = [r for r, _ in live]
-            try:
-                resps = self.engine.handle_queries(reqs, self.clock())
-                for (_, fut), resp in zip(live, resps):
-                    fut.set_result(resp)
-            except Exception as exc:  # pragma: no cover - defensive
-                for _, fut in live:
-                    if not fut.done():
-                        fut.set_exception(exc)
+    def _verify_chunk(self, chunk):
+        """Batch signature verification; returns surviving (req, fut)."""
+        # --- one multi-scalar multiplication for the round ------------
+        authed = [i for i, (_, a, _) in enumerate(chunk) if a is not None]
+        rejected: set[int] = set()
+        if authed and not ristretto.batch_verify(
+            [chunk[i][1] for i in authed]
+        ):
+            # bisect to the offenders: O(bad · log n) batch checks, so
+            # one client spraying garbage signatures cannot force
+            # per-item verification of every honest request
+            stack = [authed]
+            while stack:
+                idxs = stack.pop()
+                mid = len(idxs) // 2
+                for half in (idxs[:mid], idxs[mid:]):
+                    if not half:
+                        continue
+                    if len(half) == 1:
+                        i = half[0]
+                        if not ristretto.verify(*chunk[i][1]):
+                            rejected.add(i)
+                            chunk[i][2].set_exception(
+                                AuthFailure("bad challenge signature")
+                            )
+                    elif not ristretto.batch_verify(
+                        [chunk[i][1] for i in half]
+                    ):
+                        stack.append(half)
+        if authed:
+            self.engine.metrics.record_auth(failures=len(rejected))
+        return [
+            (req, fut)
+            for i, (req, _, fut) in enumerate(chunk)
+            if i not in rejected
+        ]
+
+    def _settle(self, pending, live):
+        """Resolve a dispatched round and deliver its responses."""
+        try:
+            resps = pending.resolve()
+            for (_, fut), resp in zip(live, resps):
+                fut.set_result(resp)
+        except Exception as exc:  # pragma: no cover - defensive
+            for _, fut in live:
+                if not fut.done():
+                    fut.set_exception(exc)
 
     def close(self):
         with self._cv:

@@ -239,6 +239,22 @@ def test_round_engine_matches_batch_oracle_with_bucket_cipher():
     _run_engine_vs_oracle(cfg, n_steps=10)
 
 
+def test_round_engine_matches_batch_oracle_density4():
+    """tree_density=4 — the max-capacity-per-HBM-byte shape used by the
+    2^22 bench sweep and the 2^24 pod config (tests/test_capacity.py):
+    randomized CRUD, then a full expiry sweep, must stay
+    oracle-identical at 4x blocks per leaf."""
+    import dataclasses
+
+    cfg = dataclasses.replace(SMALL, tree_density=4)
+    engine, oracle, t = _run_engine_vs_oracle(cfg, n_steps=12)
+    evicted_dev = engine.expire(t + 1000, period=10)
+    evicted_ora = oracle.expire(t + 1000, period=10)
+    assert evicted_dev == evicted_ora
+    assert engine.message_count() == oracle.message_count() == 0
+    assert engine.recipient_count() == oracle.recipient_count() == 0
+
+
 def _run_engine_vs_oracle(cfg, n_steps):
     engine = GrapevineEngine(cfg, seed=3)
     oracle = ReferenceEngine(config=cfg, rng=random.Random(99))
@@ -292,6 +308,7 @@ def _run_engine_vs_oracle(cfg, n_steps):
         assert engine.message_count() == oracle.message_count(), f"step {step_no}"
         assert engine.recipient_count() == oracle.recipient_count(), f"step {step_no}"
     assert engine.health()["stash_overflow"] == 0
+    return engine, oracle, t
 
 
 def test_round_engine_single_op_matches_per_op_oracle():
