@@ -33,7 +33,7 @@ def _p99(times_s: list[float]) -> float:
     return float(np.percentile(np.asarray(times_s) * 1e3, 99))
 
 
-def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2):
+def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp"):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
@@ -46,6 +46,7 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2):
         batch_size=batch,
         stash_size=stash or max(128, batch // 2 + 96),
         tree_density=density,
+        bucket_cipher_impl=cipher_impl,
     )
     ecfg = EngineConfig.from_config(cfg)
     state = init_engine(ecfg, seed=seed)
@@ -215,11 +216,14 @@ def bench_batched_read(smoke):
             "batch": batch, "capacity_log2": cap.bit_length() - 1}
 
 
-def bench_zipf_mixed(smoke):
+def bench_zipf_mixed(smoke, cipher_impl="jnp"):
     """Config 3: mixed CRUD, Zipf(1.1) recipients — hammers hot
-    mailboxes into the 62-message cap."""
+    mailboxes into the 62-message cap. ``cipher_impl="pallas"`` runs
+    the same workload through the fused VMEM keystream kernel
+    (oblivious/pallas_cipher.py) — reported as its own config line so
+    a Mosaic compile issue cannot sink the headline."""
     cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 2048, 12)
-    cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, batch)
+    cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, batch, cipher_impl=cipher_impl)
     rng = np.random.default_rng(11)
     n_id = 512
     idents = rng.integers(1, 2**31, (n_id, 8)).astype(np.uint32)
@@ -396,6 +400,7 @@ CONFIGS = [
     ("crd_loop", bench_crd_loop),
     ("batched_read", bench_batched_read),
     ("zipf_mixed", bench_zipf_mixed),
+    ("zipf_pallas_cipher", lambda smoke: bench_zipf_mixed(smoke, cipher_impl="pallas")),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),

@@ -56,6 +56,11 @@ class GrapevineConfig:
     #: analog (oblivious/bucket_cipher.py). 8 = ChaCha8 (default),
     #: 20 = RFC ChaCha20, 0 = plaintext trees.
     bucket_cipher_rounds: int = 8
+    #: cipher implementation: "jnp" (XLA, keystream materialized in HBM)
+    #: or "pallas" (fused VMEM keystream+XOR kernel,
+    #: oblivious/pallas_cipher.py; interpret mode off-TPU). Bit-identical
+    #: ciphertext either way.
+    bucket_cipher_impl: str = "jnp"
 
     def __post_init__(self):
         if self.commit not in ("phase", "op"):
@@ -70,6 +75,11 @@ class GrapevineConfig:
         if r != 0 and (r < 8 or r % 2 != 0):
             raise ValueError(
                 f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
+            )
+        if self.bucket_cipher_impl not in ("jnp", "pallas"):
+            raise ValueError(
+                f"bucket_cipher_impl must be 'jnp' or 'pallas', got "
+                f"{self.bucket_cipher_impl!r}"
             )
         if self.max_messages < 2 or self.max_messages & (self.max_messages - 1):
             raise ValueError("max_messages must be a power of two >= 2")

@@ -15,9 +15,12 @@ operators need (the reference's `mc-common` logging analog):
   ``snapshot()`` — a per-round device reduction would stall the
   dispatch pipeline for a gauge nobody reads between scrapes).
 
-Thread-safety: counters are guarded by one lock; `record_round` is
-called with the engine lock already held (the engine serializes rounds),
-so contention is nil.
+Thread-safety: all counters are guarded by this module's own lock and
+every recording entry point may be called from any thread —
+`record_round` in particular runs from `PendingRound.resolve()` outside
+the engine lock (the pipelined scheduler resolves a round after
+dispatching the next one). Do not weaken the internal lock based on
+who currently calls what.
 """
 
 from __future__ import annotations

@@ -92,6 +92,7 @@ class EngineConfig:
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=cfg.max_messages,
             ),
             mb=OramConfig(
@@ -100,6 +101,7 @@ class EngineConfig:
                 bucket_slots=cfg.bucket_slots,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=m,
             ),
             mb_table_buckets=m,

@@ -113,7 +113,12 @@ def row_keystream(
     )
     ks = chacha_blocks(
         key, ctr, bucket[:, None], epoch[:, None, 0], epoch[:, None, 1], rounds
-    ).reshape(r, n_blocks * 16)[:, :n_words]
+    )  # [r, n_blocks, 16]
+    # j-major stream order: all blocks' word 0, then word 1, … — a fixed
+    # permutation of the stream (PRF security is order-independent) that
+    # keeps each of the 16 state words contiguous along the lane axis,
+    # matching the Pallas kernel's layout (concatenate, no interleave)
+    ks = jnp.swapaxes(ks, -1, -2).reshape(r, n_blocks * 16)[:, :n_words]
     written = (epoch[:, 0] != 0) | (epoch[:, 1] != 0)
     return jnp.where(written[:, None], ks, U32(0))
 

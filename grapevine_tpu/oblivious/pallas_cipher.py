@@ -1,0 +1,120 @@
+"""Pallas TPU kernel: fused ChaCha keystream + XOR over bucket rows.
+
+The jnp cipher path (bucket_cipher.row_keystream) materializes the full
+keystream in HBM — at B=2048 on the records tree that is an extra
+~170 MB written and re-read per round, pure HBM-bandwidth overhead
+(PERF.md "next levers" 2). This kernel generates the keystream in VMEM
+tile by tile and XORs it into the row data in the same pass: one HBM
+read + one HBM write per row, no keystream traffic. The slot-index and
+value arrays are separate kernel refs, so no concatenated staging copy
+is made either.
+
+Layout: the keystream uses the j-major stream order defined by
+``row_keystream`` — word ``m`` of a row comes from ChaCha state word
+``m // n_blocks`` of block ``m % n_blocks`` — so each of the 16 output
+state arrays ([rows, n_blocks]) is a *contiguous lane range* of the
+keystream tile and assembly is a concatenate, not a 16-way interleave.
+The ChaCha core itself (quarter-round, constants, round schedule) is
+imported from bucket_cipher so the two implementations cannot drift;
+bit-identical ciphertext is asserted by tests/test_pallas_cipher.py,
+making engine states interchangeable between impls.
+
+Off-TPU the kernel runs in Pallas interpret mode (CI's CPU backend —
+the SGX_MODE=SW analog), so the selection knob is safe everywhere;
+``cipher_impl="pallas"`` on real TPU compiles the Mosaic kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .bucket_cipher import _SIGMA, _qr
+
+U32 = jnp.uint32
+
+#: VMEM budget per input/output tile (bytes) used to pick the row tile
+_TILE_BYTES = 1 << 21
+
+
+def _cipher_kernel(
+    key_ref, bucket_ref, epoch_ref, idx_ref, val_ref, oidx_ref, oval_ref,
+    *, nb, z, n_words, rounds,
+):
+    """One row tile: (idx [TR, z], val [TR, W-z]) ^= keystream rows."""
+    tr = idx_ref.shape[0]
+    ctr = jax.lax.broadcasted_iota(U32, (tr, nb), 1)
+    n1 = jnp.broadcast_to(bucket_ref[:][:, None], (tr, nb))
+    n2 = jnp.broadcast_to(epoch_ref[:, 0][:, None], (tr, nb))
+    n3 = jnp.broadcast_to(epoch_ref[:, 1][:, None], (tr, nb))
+    init = [jnp.full((tr, nb), U32(c)) for c in _SIGMA]
+    init += [jnp.broadcast_to(key_ref[0, i], (tr, nb)) for i in range(8)]
+    init += [ctr, n1, n2, n3]
+    s = list(init)
+    for _ in range(rounds // 2):
+        _qr(s, 0, 4, 8, 12)
+        _qr(s, 1, 5, 9, 13)
+        _qr(s, 2, 6, 10, 14)
+        _qr(s, 3, 7, 11, 15)
+        _qr(s, 0, 5, 10, 15)
+        _qr(s, 1, 6, 11, 12)
+        _qr(s, 2, 7, 8, 13)
+        _qr(s, 3, 4, 9, 14)
+    # j-major assembly: 16 contiguous [TR, nb] lane ranges
+    ks = jnp.concatenate([a + b for a, b in zip(s, init)], axis=1)
+    written = ((epoch_ref[:, 0] != U32(0)) | (epoch_ref[:, 1] != U32(0)))[:, None]
+    oidx_ref[:, :] = idx_ref[:, :] ^ jnp.where(written, ks[:, :z], U32(0))
+    oval_ref[:, :] = val_ref[:, :] ^ jnp.where(
+        written, ks[:, z:n_words], U32(0)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("rounds", "interpret"))
+def cipher_rows_pallas(
+    key: jax.Array,  # u32[8]
+    bucket: jax.Array,  # u32[R]
+    epoch: jax.Array,  # u32[R, 2]; 0 = identity (never written)
+    pidx: jax.Array,  # u32[R, z] slot-index words
+    pval: jax.Array,  # u32[R, W-z] value words
+    rounds: int = 8,
+    interpret: bool = False,
+):
+    """Fused ``row ^ keystream``; returns (pidx', pval'), both u32."""
+    r, z = pidx.shape
+    w = z + pval.shape[1]
+    nb = (w + 15) // 16
+    tr = max(8, min(512, _TILE_BYTES // max(1, 16 * nb * 4)))
+    # pad rows to a tile multiple; padded rows carry epoch 0 (identity)
+    r_pad = -(-r // tr) * tr
+    if r_pad != r:
+        pad = r_pad - r
+        bucket = jnp.pad(bucket, (0, pad))
+        epoch = jnp.pad(epoch, ((0, pad), (0, 0)))
+        pidx = jnp.pad(pidx, ((0, pad), (0, 0)))
+        pval = jnp.pad(pval, ((0, pad), (0, 0)))
+    oidx, oval = pl.pallas_call(
+        functools.partial(
+            _cipher_kernel, nb=nb, z=z, n_words=w, rounds=rounds
+        ),
+        grid=(r_pad // tr,),
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda i: (0, 0)),
+            pl.BlockSpec((tr,), lambda i: (i,)),
+            pl.BlockSpec((tr, 2), lambda i: (i, 0)),
+            pl.BlockSpec((tr, z), lambda i: (i, 0)),
+            pl.BlockSpec((tr, w - z), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tr, z), lambda i: (i, 0)),
+            pl.BlockSpec((tr, w - z), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, z), U32),
+            jax.ShapeDtypeStruct((r_pad, w - z), U32),
+        ],
+        interpret=interpret,
+    )(key[None, :], bucket, epoch, pidx, pval)
+    return oidx[:r], oval[:r]

@@ -73,10 +73,22 @@ def cipher_rows(
 
     One ChaCha stream per (bucket, epoch) covers the Z slot-index words
     followed by the Z*V value words — a memory snapshot of the tree
-    arrays reveals neither slot occupancy nor contents."""
+    arrays reveals neither slot occupancy nor contents.
+
+    ``cfg.cipher_impl == "pallas"`` routes through the fused Pallas
+    kernel (keystream generated in VMEM and XORed in one pass — no HBM
+    keystream materialization; oblivious/pallas_cipher.py). Both
+    implementations produce bit-identical ciphertext."""
     if not cfg.encrypted:
         return pidx, pval
     z = cfg.bucket_slots
+    if cfg.cipher_impl == "pallas":
+        from ..oblivious.pallas_cipher import cipher_rows_pallas
+
+        return cipher_rows_pallas(
+            key, buckets, epochs, pidx, pval, cfg.cipher_rounds,
+            interpret=jax.default_backend() != "tpu",
+        )
     ks = row_keystream(key, buckets, epochs, cfg.row_words, cfg.cipher_rounds)
     return pidx ^ ks[:, :z], pval ^ ks[:, z:]
 
@@ -100,6 +112,9 @@ class OramConfig:
     #: ChaCha rounds for at-rest bucket encryption; 0 disables the
     #: cipher (oblivious/bucket_cipher.py — the EPC-encryption analog)
     cipher_rounds: int = 0
+    #: "jnp" or "pallas" (fused VMEM keystream+XOR kernel; see
+    #: cipher_rows and oblivious/pallas_cipher.py)
+    cipher_impl: str = "jnp"
     #: logical block index space [0, n_blocks); None = leaves
     n_blocks: int | None = None
 
