@@ -1,0 +1,101 @@
+"""Malformed-wire fuzzing: decoders fail closed, with ValueError only.
+
+The gRPC handlers translate ValueError into INVALID_ARGUMENT
+(server/service.py); any other exception type escaping a decoder would
+surface as an opaque handler crash (UNKNOWN) — so the contract under
+test is: for arbitrary byte mutations of valid messages, every decoder
+either round-trips successfully or raises ValueError. Seeded, not
+time-based, so failures reproduce.
+"""
+
+import random
+
+import pytest
+
+from grapevine_tpu.testing.fixtures import (
+    get_seeded_rng,
+    random_query_request,
+    random_query_response,
+)
+from grapevine_tpu.wire import protowire as pw
+from grapevine_tpu.wire.records import QueryRequest, QueryResponse
+
+N_CASES = 300
+
+
+def _mutations(rng: random.Random, blob: bytes):
+    """A mix of truncations, extensions, and byte flips."""
+    b = bytearray(blob)
+    case = rng.randrange(5)
+    if case == 0:  # truncate
+        return bytes(b[: rng.randrange(len(b))])
+    if case == 1:  # extend with junk
+        return bytes(b) + bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+    if case == 2:  # flip a single byte
+        i = rng.randrange(len(b))
+        b[i] ^= rng.randrange(1, 256)
+        return bytes(b)
+    if case == 3:  # flip several bytes
+        for _ in range(rng.randrange(2, 16)):
+            i = rng.randrange(len(b))
+            b[i] ^= rng.randrange(1, 256)
+        return bytes(b)
+    return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 2048)))  # noise
+
+
+@pytest.mark.parametrize(
+    "make,unpack",
+    [
+        (lambda r: random_query_request(r).pack(), QueryRequest.unpack),
+        (lambda r: random_query_response(r).pack(), QueryResponse.unpack),
+    ],
+    ids=["request", "response"],
+)
+def test_fixed_layout_unpack_fails_closed(make, unpack):
+    rng = get_seeded_rng(1)
+    for _ in range(N_CASES):
+        blob = _mutations(rng, make(rng))
+        try:
+            unpack(blob)
+        except ValueError:
+            pass  # the only permitted failure mode
+
+
+@pytest.mark.parametrize(
+    "encode,decode",
+    [
+        (lambda r: pw.encode_query_request(random_query_request(r)),
+         pw.decode_query_request),
+        (lambda r: pw.encode_query_response(random_query_response(r)),
+         pw.decode_query_response),
+    ],
+    ids=["request", "response"],
+)
+def test_protowire_decode_fails_closed(encode, decode):
+    rng = get_seeded_rng(2)
+    for _ in range(N_CASES):
+        blob = _mutations(rng, encode(rng))
+        try:
+            decode(blob)
+        except ValueError:
+            pass
+
+
+def test_envelope_and_auth_decoders_fail_closed():
+    rng = get_seeded_rng(3)
+    env = pw.encode_envelope(
+        pw.EnvelopeMessage(data=b"\x07" * 64, aad=b"a", channel_id=b"c" * 16)
+    )
+    auth = pw.encode_auth_with_seed(
+        pw.AuthMessageWithChallengeSeed(
+            auth_message=pw.AuthMessage(data=b"\x05" * 80),
+            encrypted_challenge_seed=b"\x06" * 48,
+        )
+    )
+    for blob, dec in [(env, pw.decode_envelope), (auth, pw.decode_auth_with_seed)]:
+        for _ in range(N_CASES):
+            mut = _mutations(rng, blob)
+            try:
+                dec(mut)
+            except ValueError:
+                pass
