@@ -464,7 +464,7 @@ CONFIGS = [
     ("crd_loop", bench_crd_loop),
     ("batched_read", bench_batched_read),
     ("zipf_mixed", bench_zipf_mixed),
-    ("zipf_pallas_cipher", lambda smoke: bench_zipf_pallas(smoke)),
+    ("zipf_pallas_cipher", bench_zipf_pallas),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),

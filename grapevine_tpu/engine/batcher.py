@@ -38,14 +38,6 @@ from .round_step import engine_round_step
 from .step import engine_step
 
 
-def bytes_to_words(b: bytes) -> np.ndarray:
-    return np.frombuffer(b, dtype="<u4").copy()
-
-
-def words_to_bytes(w: np.ndarray) -> bytes:
-    return np.asarray(w, dtype="<u4").tobytes()
-
-
 def validate_request(req: QueryRequest) -> None:
     """Fail-fast checks (reference grapevine.proto:57-64,95)."""
     req.validate()
@@ -58,49 +50,65 @@ def validate_request(req: QueryRequest) -> None:
 
 
 def pack_batch(reqs: list[QueryRequest], batch_size: int, now: int) -> dict:
-    """Pack ≤batch_size validated requests into device arrays, dummy-padded."""
-    if len(reqs) > batch_size:
+    """Pack ≤batch_size validated requests into device arrays, dummy-padded.
+
+    Columnar: one ``b"".join`` + ``frombuffer`` per field instead of a
+    per-request assignment loop — at B=2048 the loop was ~14 ms of host
+    time per round, on par with the device round itself (PERF.md)."""
+    n = len(reqs)
+    if n > batch_size:
         raise ValueError("too many requests for one batch")
     b = batch_size
-    batch = {
-        "req_type": np.zeros((b,), np.uint32),
-        "auth": np.zeros((b, KEY_WORDS), np.uint32),
-        "msg_id": np.zeros((b, ID_WORDS), np.uint32),
-        "recipient": np.zeros((b, KEY_WORDS), np.uint32),
-        "payload": np.zeros((b, PAYLOAD_WORDS), np.uint32),
+
+    def col(words: int, chunks) -> np.ndarray:
+        arr = np.zeros((b, words), np.uint32)
+        if n:
+            arr[:n] = np.frombuffer(b"".join(chunks), "<u4").reshape(n, words)
+        return arr
+
+    rt = np.zeros((b,), np.uint32)
+    rt[:n] = [r.request_type for r in reqs]
+    return {
+        "req_type": rt,
+        "auth": col(KEY_WORDS, (r.auth_identity for r in reqs)),
+        "msg_id": col(ID_WORDS, (r.record.msg_id for r in reqs)),
+        "recipient": col(KEY_WORDS, (r.record.recipient for r in reqs)),
+        "payload": col(PAYLOAD_WORDS, (r.record.payload for r in reqs)),
         "now": np.uint32(min(int(now), 0xFFFFFFFF)),
     }
-    for i, req in enumerate(reqs):
-        batch["req_type"][i] = req.request_type
-        batch["auth"][i] = bytes_to_words(req.auth_identity)
-        batch["msg_id"][i] = bytes_to_words(req.record.msg_id)
-        batch["recipient"][i] = bytes_to_words(req.record.recipient)
-        batch["payload"][i] = bytes_to_words(req.record.payload)
-    return batch
 
 
 def unpack_responses(resp: dict, n: int) -> list[QueryResponse]:
-    status = np.asarray(resp["status"])
-    msg_id = np.asarray(resp["msg_id"])
-    sender = np.asarray(resp["sender"])
-    recipient = np.asarray(resp["recipient"])
-    ts = np.asarray(resp["timestamp"])
-    payload = np.asarray(resp["payload"])
-    out = []
-    for i in range(n):
-        out.append(
-            QueryResponse(
-                record=Record(
-                    msg_id=words_to_bytes(msg_id[i]),
-                    sender=words_to_bytes(sender[i]),
-                    recipient=words_to_bytes(recipient[i]),
-                    timestamp=int(ts[i]),
-                    payload=words_to_bytes(payload[i]),
-                ),
-                status_code=int(status[i]),
-            )
+    """Columnar device→wire conversion: one ``tobytes`` per field, rows
+    sliced out of the flat buffer (bytes slicing is C-speed; the old
+    per-row ``tobytes`` loop was ~8 ms at B=2048)."""
+    status = np.asarray(resp["status"])[:n].tolist()
+    ts = np.asarray(resp["timestamp"])[:n].tolist()
+
+    def rows(name: str, words: int) -> list[bytes]:
+        flat = np.ascontiguousarray(
+            np.asarray(resp[name])[:n], dtype="<u4"
+        ).tobytes()
+        sz = words * 4
+        return [flat[i * sz : (i + 1) * sz] for i in range(n)]
+
+    mids = rows("msg_id", ID_WORDS)
+    snds = rows("sender", KEY_WORDS)
+    rcps = rows("recipient", KEY_WORDS)
+    pls = rows("payload", PAYLOAD_WORDS)
+    return [
+        QueryResponse(
+            record=Record(
+                msg_id=mids[i],
+                sender=snds[i],
+                recipient=rcps[i],
+                timestamp=int(ts[i]),
+                payload=pls[i],
+            ),
+            status_code=int(status[i]),
         )
-    return out
+        for i in range(n)
+    ]
 
 
 class PendingRound:
