@@ -1,0 +1,70 @@
+"""Columnar pack_batch / unpack_responses: exact field layout + edges.
+
+The engine-vs-oracle suites cover these paths end to end; this file
+pins the codec contract directly (field byte layout, padding, n=0,
+over-capacity) so a layout regression fails with a precise message
+rather than a downstream semantic mismatch.
+"""
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.engine.batcher import pack_batch, unpack_responses
+from grapevine_tpu.engine.state import ID_WORDS, KEY_WORDS, PAYLOAD_WORDS
+from grapevine_tpu.testing.fixtures import get_seeded_rng, random_query_request
+from grapevine_tpu.wire import constants as C
+
+NOW = 1_700_000_000
+
+
+def test_pack_roundtrips_every_field_and_pads():
+    rng = get_seeded_rng(5)
+    reqs = [random_query_request(rng) for _ in range(5)]
+    batch = pack_batch(reqs, 8, NOW)
+    assert batch["req_type"].shape == (8,)
+    assert batch["auth"].shape == (8, KEY_WORDS)
+    assert batch["msg_id"].shape == (8, ID_WORDS)
+    assert batch["payload"].shape == (8, PAYLOAD_WORDS)
+    for i, r in enumerate(reqs):
+        assert int(batch["req_type"][i]) == r.request_type
+        assert batch["auth"][i].tobytes() == r.auth_identity
+        assert batch["msg_id"][i].tobytes() == r.record.msg_id
+        assert batch["recipient"][i].tobytes() == r.record.recipient
+        assert batch["payload"][i].tobytes() == r.record.payload
+    # padding slots are all-zero dummies (request_type 0)
+    for i in range(5, 8):
+        assert int(batch["req_type"][i]) == 0
+        assert not batch["auth"][i].any()
+        assert not batch["payload"][i].any()
+    assert int(batch["now"]) == NOW
+
+
+def test_pack_empty_and_overfull():
+    batch = pack_batch([], 4, NOW)
+    assert not batch["req_type"].any()
+    rng = get_seeded_rng(6)
+    with pytest.raises(ValueError):
+        pack_batch([random_query_request(rng) for _ in range(5)], 4, NOW)
+
+
+def test_unpack_slices_rows_correctly():
+    b = 6
+    resp = {
+        "status": np.arange(1, b + 1, dtype=np.uint32),
+        "msg_id": np.arange(b * ID_WORDS, dtype=np.uint32).reshape(b, ID_WORDS),
+        "sender": np.arange(b * KEY_WORDS, dtype=np.uint32).reshape(b, KEY_WORDS),
+        "recipient": np.arange(b * KEY_WORDS, dtype=np.uint32).reshape(b, KEY_WORDS) + 7,
+        "timestamp": np.arange(b, dtype=np.uint32) + 100,
+        "payload": np.arange(b * PAYLOAD_WORDS, dtype=np.uint32).reshape(b, PAYLOAD_WORDS),
+    }
+    out = unpack_responses(resp, 4)  # fewer than the device batch
+    assert len(out) == 4
+    for i, q in enumerate(out):
+        assert q.status_code == i + 1
+        assert q.record.timestamp == 100 + i
+        assert q.record.msg_id == resp["msg_id"][i].astype("<u4").tobytes()
+        assert q.record.sender == resp["sender"][i].astype("<u4").tobytes()
+        assert q.record.recipient == resp["recipient"][i].astype("<u4").tobytes()
+        assert q.record.payload == resp["payload"][i].astype("<u4").tobytes()
+        assert len(q.record.msg_id) == C.MSG_ID_SIZE
+        assert len(q.record.payload) == C.PAYLOAD_SIZE
