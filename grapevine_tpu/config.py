@@ -107,10 +107,18 @@ class GrapevineConfig:
     #:   randomized oracle-equality suites run at low fill.
     #: - **Memory**: mailbox-tier HBM per recipient is 1/load × the
     #:   mailbox size — 8× at the default (the price of no relocation).
+    #:   In absolute terms the tier is small: at a 2^20-message bus with
+    #:   2^12 recipients the mailbox tree is ~0.13 GB against the 4 GB
+    #:   records tree (~3% of engine HBM), so the 8× factor costs ~0.11
+    #:   GB — the records tier, not the mailbox tier, bounds capacity.
     #:
-    #: A relocating oblivious cuckoo scheme (bounded-iteration masked
-    #: eviction chains) would shrink memory to ~2× and kill early
-    #: failures; it costs a second path fetch per op. Planned.
+    #: A relocating scheme (two-choice or cuckoo with bounded-iteration
+    #: masked eviction chains) would shrink the factor to ~2× and kill
+    #: early failures; it costs a second mailbox path fetch per op and a
+    #: substantially hairier within-round claim/occupancy resolution in
+    #: engine/vphases.py. Deliberately deferred: the memory it saves is
+    #: ~3% of the engine while the records tree dominates, and the
+    #: early-failure path is analyzed and tested (test_mailbox_load).
     mailbox_load: float = 0.125
 
     #: blocks per tree leaf for both ORAMs. The classic Path ORAM shape
