@@ -1,0 +1,105 @@
+"""Gated randomized soak: engine ≡ oracle over many seeds and configs.
+
+Skipped by default (CI runs the fixed-seed suites in test_round.py);
+set GRAPEVINE_SOAK=N to run N seeded campaigns, each a full randomized
+CRUD session (25 batches with same-key hazards) followed by a drain-to-
+empty expiry check, cycling density × cipher × batch × cipher-impl.
+Round-3 builder runs: 360 campaigns (seeds 200-259 × 14 steps,
+300-599 × 25 steps), zero divergence.
+"""
+
+import dataclasses
+import os
+import random
+
+import pytest
+
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.testing.reference import ReferenceEngine
+from grapevine_tpu.wire import constants as C
+
+from test_round import SMALL, assert_responses_equal, key, req
+
+N_SOAK = int(os.environ.get("GRAPEVINE_SOAK", "0"))
+
+pytestmark = pytest.mark.skipif(
+    N_SOAK <= 0, reason="set GRAPEVINE_SOAK=N to run N soak campaigns"
+)
+
+NOW = 1_700_000_000
+
+VARIANTS = [
+    (2, 8, 8, "jnp"),
+    (4, 0, 16, "jnp"),
+    (2, 0, 12, "pallas"),
+    (4, 8, 6, "pallas"),
+]
+
+
+def _campaign(cfg, seed, n_steps=25):
+    engine = GrapevineEngine(cfg, seed=seed)
+    oracle = ReferenceEngine(config=cfg, rng=random.Random(seed + 1))
+    rng = random.Random(seed + 2)
+    idents = [key(i + 1) for i in range(6)]
+    live = []
+    t = NOW
+    for step_no in range(n_steps):
+        t += rng.randrange(3)
+        reqs = []
+        for _ in range(rng.randrange(1, cfg.batch_size + 1)):
+            c = rng.random()
+            if c < 0.35 or not live:
+                reqs.append(req(C.REQUEST_TYPE_CREATE, rng.choice(idents),
+                                recipient=rng.choice(idents), tag=rng.randrange(256)))
+            elif c < 0.55:
+                mid, snd, rcp = rng.choice(live)
+                reqs.append(req(C.REQUEST_TYPE_READ,
+                                rng.choice([snd, rcp, rng.choice(idents)]),
+                                msg_id=mid))
+            elif c < 0.7:
+                reqs.append(req(C.REQUEST_TYPE_READ, rng.choice(idents)))
+            elif c < 0.8:
+                mid, snd, rcp = rng.choice(live)
+                reqs.append(req(C.REQUEST_TYPE_UPDATE, rng.choice([snd, rcp]),
+                                msg_id=mid, recipient=rcp, tag=rng.randrange(256)))
+            elif c < 0.9:
+                mid, snd, rcp = rng.choice(live)
+                reqs.append(req(C.REQUEST_TYPE_DELETE,
+                                rng.choice([snd, rcp, rng.choice(idents)]),
+                                msg_id=mid, recipient=rcp))
+            else:
+                reqs.append(req(C.REQUEST_TYPE_DELETE, rng.choice(idents)))
+        dev = engine.handle_queries(reqs, t)
+        forced = [d.record.msg_id
+                  if r.request_type == C.REQUEST_TYPE_CREATE
+                  and d.status_code == C.STATUS_CODE_SUCCESS else None
+                  for r, d in zip(reqs, dev)]
+        ora = oracle.handle_batch(reqs, t, forced)
+        for j, (r, d, o) in enumerate(zip(reqs, dev, ora)):
+            assert_responses_equal(
+                d, o, f"seed {seed} step {step_no} slot {j} rt {r.request_type}"
+            )
+            if o.status_code == C.STATUS_CODE_SUCCESS:
+                if r.request_type == C.REQUEST_TYPE_CREATE:
+                    live.append((o.record.msg_id, o.record.sender, o.record.recipient))
+                elif r.request_type == C.REQUEST_TYPE_DELETE:
+                    live = [e for e in live if e[0] != o.record.msg_id]
+        assert engine.message_count() == oracle.message_count(), (seed, step_no)
+        assert engine.recipient_count() == oracle.recipient_count(), (seed, step_no)
+    assert engine.health()["stash_overflow"] == 0
+    assert engine.expire(t + 10_000, period=5) == oracle.expire(t + 10_000, period=5)
+    assert engine.message_count() == oracle.message_count() == 0
+
+
+@pytest.mark.parametrize("i", range(max(N_SOAK, 0)))
+def test_soak_campaign(i):
+    seed = 1000 + i
+    density, cipher, bs, impl = VARIANTS[i % len(VARIANTS)]
+    cfg = dataclasses.replace(
+        SMALL,
+        tree_density=density,
+        bucket_cipher_rounds=cipher,
+        batch_size=bs,
+        bucket_cipher_impl=impl,
+    )
+    _campaign(cfg, seed)
