@@ -20,7 +20,7 @@ from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
 NOW = 1_700_000_000
 
-def make_cfg(cipher_rounds: int) -> GrapevineConfig:
+def make_cfg(cipher_rounds: int, cipher_impl: str = "jnp") -> GrapevineConfig:
     return GrapevineConfig(
         max_messages=64,
         max_recipients=8,
@@ -28,6 +28,7 @@ def make_cfg(cipher_rounds: int) -> GrapevineConfig:
         batch_size=4,
         stash_size=64,
         bucket_cipher_rounds=cipher_rounds,
+        bucket_cipher_impl=cipher_impl,
     )
 
 
@@ -49,14 +50,16 @@ def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
 
 
 @pytest.mark.parametrize(
-    "cipher_rounds,n_dev", [(0, 8), (8, 8), (0, 2), (8, 4)]
+    "cipher_rounds,n_dev,impl",
+    [(0, 8, "jnp"), (8, 8, "jnp"), (0, 2, "jnp"), (8, 4, "jnp"), (8, 8, "pallas")],
 )
-def test_sharded_step_matches_single_chip(cipher_rounds, n_dev):
+def test_sharded_step_matches_single_chip(cipher_rounds, n_dev, impl):
     """Sharded ≡ single-chip at 2/4/8-way meshes, with the at-rest
     bucket cipher both off and on (the cipher's nonce arrays are sharded
-    along the bucket axis like the trees)."""
+    along the bucket axis like the trees), and the fused Pallas cipher
+    kernel running inside shard_map (the pod + pallas combination)."""
     assert len(jax.devices()) >= 8, "conftest forces an 8-device CPU mesh"
-    ecfg = EngineConfig.from_config(make_cfg(cipher_rounds))
+    ecfg = EngineConfig.from_config(make_cfg(cipher_rounds, impl))
 
     state = init_engine(ecfg, seed=3)
     single = jax.jit(engine_round_step, static_argnums=(0,))
