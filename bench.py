@@ -82,7 +82,10 @@ def _run_rounds(ecfg, state, step, batches, n_rounds):
     # throughput from fused rounds: stack the batch stream, scan it
     from grapevine_tpu.engine.round_step import engine_round_step
 
-    n_fused = max(8, len(batches))  # ≥8 rounds per dispatch
+    # rounds per dispatch: scan compiles its body once regardless of
+    # length, so a longer chain costs no compile time and amortizes the
+    # per-dispatch overhead further
+    n_fused = max(16, len(batches))
     order = [i % len(batches) for i in range(n_fused)]
     stacked = {
         k: (jnp.stack([jnp.asarray(batches[i][k]) for i in order]) if k != "now"
@@ -171,7 +174,8 @@ def make_batches(n_batches: int, batch_size: int, seed: int = 7):
 
 def bench_crd_loop(smoke):
     """Config 1: one client, create → zero-id read → zero-id delete."""
-    cap, batch, n_rounds = (1 << 10, 4, 4) if smoke else (1 << 16, 66, 24)
+    # batch 64 (lane-aligned): 21 C-R-D triples + one padding dummy slot
+    cap, batch, n_rounds = (1 << 10, 4, 4) if smoke else (1 << 16, 64, 32)
     cfg, ecfg, state, step = _mk_engine(cap, 1 << 8, batch)
     rng = np.random.default_rng(3)
     me = rng.integers(1, 2**31, (8,)).astype(np.uint32)
