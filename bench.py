@@ -1,14 +1,17 @@
 #!/usr/bin/env python3
-"""The five BASELINE benchmark configs, with p99 round latency.
+"""The BASELINE benchmark configs, with p99 round latency.
 
-Configs (BASELINE.md / BASELINE.json):
-  1. crd_loop      single-client create→read→delete loop, 2^16 bus
-  2. batched_read  1024 concurrent explicit-id reads, 2^20 bus
-  3. zipf_mixed    mixed CRUD, Zipf recipient keys, 62-cap stress
-  4. expiry_sweep  timestamped eviction scan over the full bus
-  5. sharded       bucket-tree sharded over a device mesh (CPU dryrun —
-                   single TPU chip under the driver; ICI path exercised
-                   on the virtual mesh, see tests/test_parallel.py)
+Configs (BASELINE.md / BASELINE.json, plus two extensions):
+  1. crd_loop            single-client create→read→delete loop, 2^16 bus
+  2. batched_read        2048 concurrent explicit-id reads, 2^20 bus
+  3. zipf_mixed          mixed CRUD, Zipf recipient keys, 62-cap stress
+  3b. zipf_pallas_cipher the same workload through the fused Pallas
+                         cipher kernel (TPU backends only)
+  4. expiry_sweep        timestamped eviction scan, 2^22 at density 4
+  5. sharded             bucket-tree sharded over a device mesh (CPU
+                         mesh subprocess when one chip is visible)
+  6. server_loopback     full-stack gRPC: session crypto + batched
+                         verification + pipelined scheduler + engine
 
 stdout is ONE JSON line: the headline mixed-CRUD throughput at the
 largest batched config, with every config's (ops/s, p99 round ms)
