@@ -258,3 +258,56 @@ def test_expiry_clock_regression_keeps_future_records():
     assert engine.message_count() == 1
     (rr,) = engine.handle_queries([req(C.REQUEST_TYPE_READ, key(2))], NOW)
     assert rr.status_code == C.STATUS_CODE_SUCCESS
+
+
+def test_default_mailbox_cap_62_enforced_and_drains():
+    """The production default cap (62, the reference's compile-time
+    constant, README.md:78-80) enforced at the exact boundary: 62
+    creates to one recipient succeed, the 63rd fails, and the mailbox
+    drains in creation order — against the oracle throughout."""
+    import random as _random
+
+    from grapevine_tpu.testing.reference import ReferenceEngine
+
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=128,
+        max_recipients=8,
+        batch_size=16,
+        stash_size=128,
+    )
+    assert cfg.mailbox_cap == 62
+    engine = GrapevineEngine(cfg, seed=4)
+    oracle = ReferenceEngine(config=cfg, rng=_random.Random(5))
+    a, b = key(1), key(2)
+    statuses = []
+    t = NOW
+    for start in range(0, 64, 16):
+        reqs = [
+            req(C.REQUEST_TYPE_CREATE, a, recipient=b, tag=start + j)
+            for j in range(16)
+        ]
+        dev = engine.handle_queries(reqs, t)
+        forced = [
+            d.record.msg_id if d.status_code == C.STATUS_CODE_SUCCESS else None
+            for d in dev
+        ]
+        ora = oracle.handle_batch(reqs, t, forced)
+        for d, o in zip(dev, ora):
+            assert d.status_code == o.status_code
+            statuses.append(d.status_code)
+    assert statuses.count(C.STATUS_CODE_SUCCESS) == 62
+    assert statuses[:62] == [C.STATUS_CODE_SUCCESS] * 62
+    assert set(statuses[62:]) == {C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT}
+    assert engine.message_count() == oracle.message_count() == 62
+    # drain in creation order (zero-id pop = oldest first)
+    for start in range(0, 62, 16):
+        n = min(16, 62 - start)
+        reqs = [req(C.REQUEST_TYPE_DELETE, b) for _ in range(n)]
+        dev = engine.handle_queries(reqs, t + 1)
+        ora = oracle.handle_batch(reqs, t + 1)
+        for j, (d, o) in enumerate(zip(dev, ora)):
+            assert d.status_code == o.status_code == C.STATUS_CODE_SUCCESS
+            assert d.record.payload == o.record.payload
+            assert d.record.payload[0] == start + j  # oldest-first order
+    assert engine.message_count() == oracle.message_count() == 0
