@@ -58,6 +58,30 @@ def cross_round_repeat_rate(leaf_seq: np.ndarray) -> float:
     return float(np.mean(leaf_seq[1:] == leaf_seq[:-1]))
 
 
+def twosample_z(
+    leaves_a: np.ndarray, leaves_b: np.ndarray, n_leaves: int, bins: int = 16
+) -> float:
+    """Normal-approximated two-sample chi-square z between two transcript
+    leaf samples (e.g. all-READ rounds vs all-DELETE rounds). Honest
+    engines draw both from the same uniform distribution → |z| = O(1);
+    an op-type-dependent leaf bias separates the histograms and blows z
+    up. Complements the same-seed bit-equality test, which cannot see a
+    bias that affects both runs identically."""
+    a = np.asarray(leaves_a).ravel().astype(np.int64)
+    b = np.asarray(leaves_b).ravel().astype(np.int64)
+    assert n_leaves % bins == 0
+    ca = np.bincount(a * bins // n_leaves, minlength=bins)[:bins].astype(float)
+    cb = np.bincount(b * bins // n_leaves, minlength=bins)[:bins].astype(float)
+    na, nb = ca.sum(), cb.sum()
+    k1, k2 = np.sqrt(nb / na), np.sqrt(na / nb)
+    tot = ca + cb
+    with np.errstate(invalid="ignore", divide="ignore"):
+        terms = np.where(tot > 0, (k1 * ca - k2 * cb) ** 2 / np.maximum(tot, 1), 0.0)
+    chi2 = float(terms.sum())
+    dof = bins - 1
+    return (chi2 - dof) / np.sqrt(2 * dof)
+
+
 def uniformity_z(leaves: np.ndarray, n_leaves: int, bins: int = 16) -> float:
     """Normal-approximated chi-square z-score of the leaf histogram.
 

@@ -35,6 +35,7 @@ from grapevine_tpu.testing.leakcheck import (
 )
 
 U32 = jnp.uint32
+NOW = 1_700_000_000
 
 CFG = OramConfig(height=12, value_words=4, stash_size=128)
 B = 16
@@ -195,3 +196,85 @@ def test_engine_transcript_passes_all_detectors():
     assert abs(uniformity_z(np.concatenate(rec_pool), ecfg.rec.leaves, bins=8)) < 6
     # the SAME record read every round draws fresh leaves each time
     assert cross_round_repeat_rate(np.asarray(rec_leaves_of_mid)) < 0.3
+
+
+def test_rud_transcript_distributions_indistinguishable():
+    """SURVEY §4 pyramid item 4, distributional form: transcripts of
+    all-READ vs all-UPDATE vs all-DELETE sessions over DIFFERENT random
+    engines are two-sample-indistinguishable; a synthetic op-type leaf
+    bias is caught by the same detector (the canary)."""
+    import random
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.engine.state import EngineConfig
+    from grapevine_tpu.testing.leakcheck import twosample_z
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=256,
+        max_recipients=32,
+        mailbox_cap=8,
+        batch_size=4,
+        stash_size=96,
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    a, b = bytes([1]) * 32, bytes([2]) * 32
+
+    def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
+        return QueryRequest(
+            request_type=rt,
+            auth_identity=auth,
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=msg_id,
+                recipient=recipient,
+                payload=bytes([tag]) * C.PAYLOAD_SIZE,
+            ),
+        )
+
+    def session_leaves(rt, seed, n_rounds=12):
+        """Create a message, then hammer it with `rt` ops; pool the
+        records-round leaves of the rt rounds."""
+        rng = random.Random(seed)
+        e = GrapevineEngine(cfg, seed=seed)
+        (r0,) = e.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
+        assert r0.status_code == C.STATUS_CODE_SUCCESS
+        pool = []
+        for t in range(n_rounds):
+            if rt == C.REQUEST_TYPE_DELETE:
+                # recreate so the delete target always exists
+                (rc,) = e.handle_queries(
+                    [req(C.REQUEST_TYPE_CREATE, a, recipient=b, tag=t & 0xFF)],
+                    NOW + 2 * t,
+                )
+                mid = rc.record.msg_id
+            else:
+                mid = r0.record.msg_id
+            (r,) = e.handle_queries(
+                [req(rt, b, msg_id=mid, recipient=b, tag=rng.randrange(256))],
+                NOW + 2 * t + 1,
+            )
+            _, tr = e.handle_queries_with_transcript(
+                [req(C.REQUEST_TYPE_READ, b, msg_id=r0.record.msg_id)],
+                NOW + 2 * t + 1,
+            )
+            pool.append(int(np.asarray(tr)[0, 1]))
+        return np.asarray(pool)
+
+    pools = {}
+    for rt in (C.REQUEST_TYPE_READ, C.REQUEST_TYPE_UPDATE, C.REQUEST_TYPE_DELETE):
+        pools[rt] = np.concatenate([session_leaves(rt, s) for s in range(6)])
+    n_leaves = ecfg.rec.leaves
+    zs = [
+        twosample_z(pools[C.REQUEST_TYPE_READ], pools[C.REQUEST_TYPE_UPDATE], n_leaves, bins=8),
+        twosample_z(pools[C.REQUEST_TYPE_READ], pools[C.REQUEST_TYPE_DELETE], n_leaves, bins=8),
+    ]
+    for z in zs:
+        assert abs(z) < 6, f"honest R/U/D distributions separated (z={z})"
+    # canary: a leaf bias keyed on op type must be caught
+    biased = pools[C.REQUEST_TYPE_DELETE] % (n_leaves // 8)  # squashed range
+    z_bad = twosample_z(pools[C.REQUEST_TYPE_READ], biased, n_leaves, bins=8)
+    assert z_bad > 20, f"detector missed the op-type bias (z={z_bad})"
