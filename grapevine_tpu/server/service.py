@@ -8,8 +8,9 @@ blobs. Implemented with grpc's generic handlers and the hand-rolled
 protowire codec — no protoc build step.
 
 Per-request auth (reference README.md:187-199): the server advances the
-session's challenge RNG on *every* Query before decrypting (lockstep,
-README.md:195-196), verifies the Schnorr signature over the challenge
+session's challenge RNG on every *authenticated* Query (lockstep,
+README.md:195-196; the AEAD decrypt proves channel ownership before a
+challenge is consumed), verifies the Schnorr signature over the challenge
 under context ``b"grapevine-challenge"``, and fails fast with
 INVALID_ARGUMENT on bad signatures or malformed requests (the reference's
 hard-error behavior, grapevine.proto:57-64).
@@ -140,14 +141,25 @@ class GrapevineServer:
                 session = None
         if session is None:
             context.abort(grpc.StatusCode.UNAUTHENTICATED, "unknown channel")
-        session.last_used = now
         with session.lock:
-            # lockstep: draw the challenge before attempting decryption
-            challenge = session.challenge_rng.next_challenge()
+            # AEAD authentication FIRST: a replayed or injected envelope
+            # (channel_id travels in the clear) must fail here without
+            # consuming a challenge or advancing any cipher state —
+            # otherwise one injected Query permanently desyncs the
+            # legitimate client's lockstep (an injection-DoS the
+            # reference never faced behind TLS). The channel's recv
+            # counter likewise only advances on successful decryption.
             try:
                 plaintext = session.channel.decrypt(envelope.data, aad=envelope.aad)
             except Exception:
                 context.abort(grpc.StatusCode.UNAUTHENTICATED, "decryption failed")
+            # lockstep: the sender has proven channel ownership; draw
+            # their challenge (client drew the same one before signing).
+            # Only now refresh the idle timestamp — unauthenticated
+            # garbage must not keep a session alive past its TTL or pin
+            # it against LRU eviction
+            challenge = session.challenge_rng.next_challenge()
+            session.last_used = now
             try:
                 req = QueryRequest.unpack(plaintext)
                 validate_request(req)

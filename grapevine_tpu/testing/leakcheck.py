@@ -58,6 +58,13 @@ def cross_round_repeat_rate(leaf_seq: np.ndarray) -> float:
     return float(np.mean(leaf_seq[1:] == leaf_seq[:-1]))
 
 
+def _leaf_hist(leaves: np.ndarray, n_leaves: int, bins: int) -> np.ndarray:
+    """Histogram of leaves into ``bins`` equal ranges (shared binning)."""
+    leaves = np.asarray(leaves).ravel().astype(np.int64)
+    assert n_leaves % bins == 0, "bins must divide the leaf range"
+    return np.bincount(leaves * bins // n_leaves, minlength=bins)[:bins]
+
+
 def twosample_z(
     leaves_a: np.ndarray, leaves_b: np.ndarray, n_leaves: int, bins: int = 16
 ) -> float:
@@ -67,11 +74,8 @@ def twosample_z(
     an op-type-dependent leaf bias separates the histograms and blows z
     up. Complements the same-seed bit-equality test, which cannot see a
     bias that affects both runs identically."""
-    a = np.asarray(leaves_a).ravel().astype(np.int64)
-    b = np.asarray(leaves_b).ravel().astype(np.int64)
-    assert n_leaves % bins == 0
-    ca = np.bincount(a * bins // n_leaves, minlength=bins)[:bins].astype(float)
-    cb = np.bincount(b * bins // n_leaves, minlength=bins)[:bins].astype(float)
+    ca = _leaf_hist(leaves_a, n_leaves, bins).astype(float)
+    cb = _leaf_hist(leaves_b, n_leaves, bins).astype(float)
     na, nb = ca.sum(), cb.sum()
     k1, k2 = np.sqrt(nb / na), np.sqrt(na / nb)
     tot = ca + cb
@@ -92,12 +96,8 @@ def uniformity_z(leaves: np.ndarray, n_leaves: int, bins: int = 16) -> float:
     instead of an exact p-value to avoid a scipy dependency; the canary
     asserts orders-of-magnitude separation, not a 5% cut.)
     """
-    leaves = np.asarray(leaves).ravel()
-    n = leaves.size
-    assert n_leaves % bins == 0, "bins must divide the leaf range"
-    counts = np.bincount(
-        leaves.astype(np.int64) * bins // n_leaves, minlength=bins
-    )[:bins]
+    counts = _leaf_hist(leaves, n_leaves, bins)
+    n = int(counts.sum())
     expected = n / bins
     chi2 = float(np.sum((counts - expected) ** 2) / expected)
     dof = bins - 1

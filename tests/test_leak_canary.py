@@ -237,7 +237,7 @@ def test_rud_transcript_distributions_indistinguishable():
 
     def session_leaves(rt, seed, n_rounds=12):
         """Create a message, then hammer it with `rt` ops; pool the
-        records-round leaves of the rt rounds."""
+        records-round leaf of each rt round itself."""
         rng = random.Random(seed)
         e = GrapevineEngine(cfg, seed=seed)
         (r0,) = e.handle_queries([req(C.REQUEST_TYPE_CREATE, a, recipient=b)], NOW)
@@ -253,15 +253,14 @@ def test_rud_transcript_distributions_indistinguishable():
                 mid = rc.record.msg_id
             else:
                 mid = r0.record.msg_id
-            (r,) = e.handle_queries(
+            resps, tr = e.handle_queries_with_transcript(
                 [req(rt, b, msg_id=mid, recipient=b, tag=rng.randrange(256))],
                 NOW + 2 * t + 1,
             )
-            _, tr = e.handle_queries_with_transcript(
-                [req(C.REQUEST_TYPE_READ, b, msg_id=r0.record.msg_id)],
-                NOW + 2 * t + 1,
-            )
-            pool.append(int(np.asarray(tr)[0, 1]))
+            # the rt op itself must succeed — a silently failing op
+            # would make all three pools identical no-op samples
+            assert resps[0].status_code == C.STATUS_CODE_SUCCESS
+            pool.append(int(np.asarray(tr)[0, 1]))  # the rt round's leaf
         return np.asarray(pool)
 
     pools = {}

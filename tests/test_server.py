@@ -249,3 +249,64 @@ def test_scheduler_bisection_rejects_only_bad_signatures():
                 assert isinstance(results[i], AuthFailure), i
     finally:
         sched.close()
+
+
+def test_replayed_and_injected_envelopes_do_not_desync_session(server):
+    """A captured Query envelope replayed verbatim, or garbage injected
+    with a valid (cleartext) channel_id, must be rejected WITHOUT
+    consuming a lockstep challenge or advancing cipher state — otherwise
+    one injected request permanently desyncs the legitimate client
+    (an injection-DoS; see service._query). The session keeps working."""
+    from grapevine_tpu.session import ristretto
+    from grapevine_tpu.wire import protowire as pw
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    srv, port = server
+    c = make_client(port, 41)
+    peer = make_client(port, 42)
+
+    # hand-rolled query (mirrors client._query) so we hold the raw bytes
+    challenge = c._challenge.next_challenge()
+    req = QueryRequest(
+        request_type=C.REQUEST_TYPE_CREATE,
+        auth_identity=c.public_key,
+        auth_signature=ristretto.sign(
+            c.sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
+        ),
+        record=RequestRecord(
+            recipient=peer.public_key, payload=pl(b"captured")
+        ),
+    )
+    raw = pw.encode_envelope(
+        pw.EnvelopeMessage(
+            channel_id=c._channel_id, data=c._channel.encrypt(req.pack())
+        )
+    )
+    reply = pw.decode_envelope(c._query_rpc(raw))
+    from grapevine_tpu.wire.records import QueryResponse
+
+    r = QueryResponse.unpack(c._channel.decrypt(reply.data))
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+
+    # 1. replay the captured envelope verbatim
+    with pytest.raises(grpc.RpcError) as exc:
+        c._query_rpc(raw)
+    assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    # 2. inject garbage under the same (cleartext) channel id
+    forged = pw.encode_envelope(
+        pw.EnvelopeMessage(channel_id=c._channel_id, data=b"\x13" * 256)
+    )
+    with pytest.raises(grpc.RpcError) as exc:
+        c._query_rpc(forged)
+    assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    # 3. the legitimate session is fully intact: lockstep + counters
+    r = peer.read()
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    assert r.record.payload == pl(b"captured")
+    for _ in range(3):
+        assert c.read().status_code in (
+            C.STATUS_CODE_SUCCESS,
+            C.STATUS_CODE_NOT_FOUND,
+        )
