@@ -7,6 +7,10 @@ bad-signature queries, then checks: every thread finished (no deadlock
 in the pipeline's drain paths), every response is protocol-consistent,
 bad signatures were rejected AND counted, and the engine's aggregate
 state reconciles with the per-thread tallies.
+
+Round-3 builder campaigns (single host core): 45 s, 180 s, and 2400 s —
+the long run processed 666,533 ops in 187,540 rounds with 36,773 bad
+signatures rejected; zero deadlocks, protocol violations, or overflow.
 """
 
 import os
