@@ -4,8 +4,9 @@ Skipped by default (CI runs the fixed-seed suites in test_round.py);
 set GRAPEVINE_SOAK=N to run N seeded campaigns, each a full randomized
 CRUD session (25 batches with same-key hazards) followed by a drain-to-
 empty expiry check, cycling density × cipher × batch × cipher-impl.
-Round-3 builder runs: 1064 campaigns across four geometry mixes
-(seeds 200-259, 300-599, 600-1099, 2000-2199; batch 6-32, density
+Round-3 builder runs: 1,214 campaigns across five geometry mixes
+(seeds 200-259, 300-599, 600-1099, 2000-2199, 3000-3149 — the last at
+2 identities for extreme same-key contention; batch 6-32, density
 1/2/4, cipher on/off, jnp/pallas), zero divergence.
 """
 
