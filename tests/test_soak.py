@@ -4,10 +4,11 @@ Skipped by default (CI runs the fixed-seed suites in test_round.py);
 set GRAPEVINE_SOAK=N to run N seeded campaigns, each a full randomized
 CRUD session (25 batches with same-key hazards) followed by a drain-to-
 empty expiry check, cycling density × cipher × batch × cipher-impl.
-Round-3 builder runs: 1,214 campaigns across five geometry mixes
-(seeds 200-259, 300-599, 600-1099, 2000-2199, 3000-3149 — the last at
-2 identities for extreme same-key contention; batch 6-32, density
-1/2/4, cipher on/off, jnp/pallas), zero divergence.
+Round-3 builder runs: 1,294 campaigns across six mixes — phase-major
+(seeds 200-259, 300-599, 600-1099, 2000-2199, and 3000-3149 at 2
+identities for extreme same-key contention) plus 80 op-major campaigns
+(seeds 4000-4079 vs the per-op oracle); batch 4-32, density 1/2/4,
+cipher on/off, jnp/pallas — zero divergence.
 """
 
 import dataclasses
