@@ -467,50 +467,147 @@ def bench_server_loopback(smoke):
         server.stop()
 
 
+# Headline config FIRST: if the run later hits a budget wall or the
+# driver's own timeout, the metric that matters is already captured
+# (VERDICT r3, next-round #1b).
 CONFIGS = [
-    ("crd_loop", bench_crd_loop),
-    ("batched_read", bench_batched_read),
     ("zipf_mixed", bench_zipf_mixed),
+    ("batched_read", bench_batched_read),
     ("zipf_pallas_cipher", bench_zipf_pallas),
+    ("crd_loop", bench_crd_loop),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),
 ]
 
 
+def _probe_backend(timeout_s: float):
+    """Prove the default backend initializes AND runs a computation.
+
+    In a subprocess, so a wedged backend init (r3: the axon relay
+    burned 1,505 s inside ``crd_loop`` before erroring) can never hang
+    the bench itself. Returns (backend_name, None) or (None, error).
+    """
+    import os
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "x = jnp.ones((256, 256), jnp.float32)\n"
+        "(x @ x).block_until_ready()\n"
+        "print('PROBE_OK', jax.default_backend(), jax.devices()[0].platform)\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe timed out after {timeout_s:.0f}s"
+    except Exception as e:  # pragma: no cover
+        return None, f"backend probe failed to launch: {type(e).__name__}: {e}"
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.split()[1], None
+    return None, f"backend probe rc={out.returncode}: {out.stderr[-300:]!r}"
+
+
+class _ConfigTimeout(Exception):
+    pass
+
+
+def _run_capped(fn, smoke: bool, cap_s: float):
+    """Run one config under a SIGALRM cap. The benches loop in Python
+    between device dispatches, so the alarm lands between iterations;
+    a truly wedged C call is instead covered by the probe (init) and by
+    snapshot emission (the last stdout line stays parseable)."""
+    import signal
+
+    def _handler(signum, frame):
+        raise _ConfigTimeout(f"config exceeded {cap_s:.0f}s cap")
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(max(1, int(cap_s)))
+    try:
+        return fn(smoke)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _emit(results, meta):
+    """Print the full result JSON as one line. Called after EVERY
+    config: if the driver kills the process mid-run, the last complete
+    stdout line is still a parseable snapshot with the configs that
+    finished — never again an empty ``parsed: null`` artifact."""
+    headline = results.get("zipf_mixed", {}).get("ops_per_sec", 0.0)
+    line = {
+        "metric": "oblivious_crud_ops_per_sec",
+        "value": headline,
+        "unit": "ops/s",
+        "vs_baseline": round(headline / 1_000_000, 6),
+        "configs": results,
+    }
+    line.update(meta)
+    sys.stdout.write(json.dumps(line) + "\n")
+    sys.stdout.flush()
+
+
 def main():
+    import os
+
     smoke = "--smoke" in sys.argv
+    budget_s = float(os.environ.get("GRAPEVINE_BENCH_BUDGET_S", "1500"))
+    per_cfg_s = float(os.environ.get("GRAPEVINE_BENCH_CONFIG_S", "420"))
+    t_start = time.perf_counter()
+    results: dict = {}
+    meta: dict = {"sizes": "smoke" if smoke else "full"}
+    strict_smoke = smoke
     if smoke:
         # smoke mode must not grab (or wait on) TPU hardware; the env var
         # alone loses to platform-pinning plugin hooks, so pin via config
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    results = {}
+        meta["backend"] = "cpu"
+    else:
+        backend, err = _probe_backend(float(os.environ.get(
+            "GRAPEVINE_BENCH_PROBE_S", "300")))
+        if backend is None:
+            # Fail fast: do NOT let all seven configs rediscover the
+            # outage serially (r3 rc=124). Pin CPU and run smoke sizes
+            # so the artifact still carries data, flagged as fallback.
+            meta.update(backend="cpu-fallback", probe_error=err,
+                        sizes="smoke")
+            smoke = True
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            print(f"[bench] PROBE FAILED ({err}); cpu-fallback smoke run",
+                  file=sys.stderr, flush=True)
+        else:
+            meta["backend"] = backend
+    _emit(results, meta)  # a parseable line exists from t=0
     for name, fn in CONFIGS:
+        elapsed = time.perf_counter() - t_start
+        if elapsed > budget_s:
+            results[name] = {"skipped":
+                             f"global budget {budget_s:.0f}s exhausted"}
+            _emit(results, meta)
+            continue
+        cap = min(per_cfg_s, max(60.0, budget_s - elapsed))
         t0 = time.perf_counter()
         try:
-            results[name] = fn(smoke)
+            results[name] = _run_capped(fn, smoke, cap)
         except Exception as e:  # one config must not sink the others
             results[name] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[bench] {name}: {results[name]} ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr, flush=True)
-    if smoke:
+        _emit(results, meta)
+    if strict_smoke:
         for name, r in results.items():
             assert "error" not in r, f"{name} failed in smoke mode: {r}"
-    # headline: largest-batch mixed CRUD throughput (the north-star metric)
-    headline = results.get("zipf_mixed", {}).get("ops_per_sec", 0.0)
-    print(
-        json.dumps(
-            {
-                "metric": "oblivious_crud_ops_per_sec",
-                "value": headline,
-                "unit": "ops/s",
-                "vs_baseline": round(headline / 1_000_000, 6),
-                "configs": results,
-            }
-        )
-    )
+    _emit(results, meta)
 
 
 if __name__ == "__main__":

@@ -61,6 +61,12 @@ class GrapevineConfig:
     #: oblivious/pallas_cipher.py; interpret mode off-TPU). Bit-identical
     #: ciphertext either way.
     bucket_cipher_impl: str = "jnp"
+    #: per-request signature scheme: "schnorrkel" (sr25519, byte-compatible
+    #: with the reference's sign_schnorrkel clients — README.md:193-199,
+    #: session/schnorrkel.py) or "rfc9496" (the same-shape plain Schnorr
+    #: this repo shipped first, session/ristretto.py). Server and clients
+    #: must agree.
+    signature_scheme: str = "schnorrkel"
 
     def __post_init__(self):
         if self.commit not in ("phase", "op"):
@@ -80,6 +86,11 @@ class GrapevineConfig:
             raise ValueError(
                 f"bucket_cipher_impl must be 'jnp' or 'pallas', got "
                 f"{self.bucket_cipher_impl!r}"
+            )
+        if self.signature_scheme not in ("schnorrkel", "rfc9496"):
+            raise ValueError(
+                f"signature_scheme must be 'schnorrkel' or 'rfc9496', got "
+                f"{self.signature_scheme!r}"
             )
         if self.max_messages < 2 or self.max_messages & (self.max_messages - 1):
             raise ValueError("max_messages must be a power of two >= 2")

@@ -155,7 +155,15 @@ class GrapevineEngine:
     def handle_queries(
         self, reqs: list[QueryRequest], now: int
     ) -> list[QueryResponse]:
-        """Process requests in slot order (padding to full batches)."""
+        """Process requests in slot order (padding to full batches).
+
+        Atomicity is **per round**, not per call: the engine lock is
+        taken per batch_size chunk, so two concurrent multi-batch calls
+        may interleave at round boundaries (each round itself is atomic
+        and slot-ordered). This is intended — it is exactly the
+        interleaving concurrent gRPC clients produce through the
+        scheduler, and the soak suite exercises it; a caller needing a
+        multi-round transaction must hold its own lock."""
         for r in reqs:  # all-or-nothing: nothing commits if any is malformed
             validate_request(r)
         out: list[QueryResponse] = []

@@ -77,6 +77,8 @@ def _bind(handle):
     handle.r255_encode.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     handle.r255_mult_base.restype = ctypes.c_int
     handle.r255_mult_base.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    handle.r255_keccak_f1600.restype = None
+    handle.r255_keccak_f1600.argtypes = [ctypes.POINTER(ctypes.c_char)]
     if handle.r255_init() != 0:
         return None
     return handle
@@ -102,6 +104,15 @@ def reencode(enc: bytes) -> bytes | None:
     with _lock:
         rc = lib.r255_encode(out, enc)
     return bytes(out.raw) if rc == 0 else None
+
+
+def keccak_f1600(state: bytearray) -> None:
+    """In-place Keccak-f[1600] on a 200-byte state (merlin hot path).
+
+    No module lock: the C function writes only the caller's buffer (no
+    static scratch), so concurrent calls on distinct states are safe."""
+    buf = (ctypes.c_char * 200).from_buffer(state)
+    lib.r255_keccak_f1600(buf)
 
 
 def mult_base(scalar_le: bytes) -> bytes | None:

@@ -517,3 +517,61 @@ int r255_mult_base(uint8_t out[32], const uint8_t s[32]) {
     ristretto_encode_ge(out, &p);
     return 0;
 }
+
+/* ------------------------------------------------------------------ */
+/* Keccak-f[1600] (FIPS 202 permutation), for the merlin/STROBE layer  */
+/* under sr25519 signatures (session/merlin.py).  The pure-Python      */
+/* permutation costs ~10^2 us; per-request signature verification runs */
+/* several permutations, so the hot path dispatches here when loaded.  */
+/* State: 200 bytes, 25 little-endian u64 lanes.                       */
+/* ------------------------------------------------------------------ */
+
+static const uint64_t keccak_rc[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
+};
+
+static const int keccak_rot[25] = {
+    0, 1, 62, 28, 27, 36, 44, 6, 55, 20, 3, 10, 43, 25, 39,
+    41, 45, 15, 21, 8, 18, 2, 61, 56, 14,
+};
+
+static uint64_t rotl64(uint64_t v, int n) {
+    return n == 0 ? v : (v << n) | (v >> (64 - n));
+}
+
+void r255_keccak_f1600(uint8_t state[200]) {
+    uint64_t a[25];
+    for (int i = 0; i < 25; i++) {
+        uint64_t v = 0;
+        for (int j = 7; j >= 0; j--) v = (v << 8) | state[8 * i + j];
+        a[i] = v;
+    }
+    for (int round = 0; round < 24; round++) {
+        uint64_t c[5], d[5], b[25];
+        for (int x = 0; x < 5; x++)
+            c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+        for (int x = 0; x < 5; x++)
+            d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 25; y += 5) a[x + y] ^= d[x];
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 5; y++)
+                b[y + 5 * ((2 * x + 3 * y) % 5)] =
+                    rotl64(a[x + 5 * y], keccak_rot[x + 5 * y]);
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 25; y += 5)
+                a[x + y] = b[x + y] ^ (~b[(x + 1) % 5 + y] & b[(x + 2) % 5 + y]);
+        a[0] ^= keccak_rc[round];
+    }
+    for (int i = 0; i < 25; i++) {
+        uint64_t v = a[i];
+        for (int j = 0; j < 8; j++) { state[8 * i + j] = (uint8_t)v; v >>= 8; }
+    }
+}

@@ -85,9 +85,25 @@ def cipher_rows(
     if cfg.cipher_impl == "pallas":
         from ..oblivious.pallas_cipher import cipher_rows_pallas
 
+        interpret = jax.default_backend() != "tpu"
+        if interpret and pidx.shape[0] >= 2048:
+            # trace-time (once per compile), not per round: interpret
+            # mode on a production-size engine means thousands of
+            # per-tile host dispatches — a silent perf cliff on any
+            # non-TPU backend (ADVICE r3). Correctness is unaffected.
+            import warnings
+
+            warnings.warn(
+                f"pallas bucket cipher running in interpret mode on "
+                f"backend {jax.default_backend()!r} with "
+                f"{pidx.shape[0]} rows/round — expect a severe "
+                f"slowdown; use bucket_cipher_impl='jnp' off-TPU",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return cipher_rows_pallas(
             key, buckets, epochs, pidx, pval, cfg.cipher_rounds,
-            interpret=jax.default_backend() != "tpu",
+            interpret=interpret,
         )
     ks = row_keystream(key, buckets, epochs, cfg.row_words, cfg.cipher_rounds)
     return pidx ^ ks[:, :z], pval ^ ks[:, z:]

@@ -2,8 +2,21 @@
 
 The analog of the reference's ``grapevine-server`` binary + ``uri`` crate
 (reference README.md:122-128, uri/src/lib.rs; SURVEY.md §1 layers 1,6,7).
+
+``GrapevineServer`` is imported lazily: the client library and URI
+parsing must stay importable without pulling in the engine (and with it
+jax + a device backend) — a client process never needs a device.
 """
 
-from .uri import GrapevineUri  # noqa: F401
-from .service import GrapevineServer  # noqa: F401
+from .uri import GrapevineUri, SERVICE_NAME  # noqa: F401
 from .client import GrapevineClient  # noqa: F401
+
+__all__ = ["GrapevineUri", "SERVICE_NAME", "GrapevineClient", "GrapevineServer"]
+
+
+def __getattr__(name):
+    if name == "GrapevineServer":
+        from .service import GrapevineServer
+
+        return GrapevineServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
         "quiescence policy, 8ms cap / 2ms idle gap)",
     )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
+    p.add_argument(
+        "--identity-seed",
+        help="64 hex chars: derive a STABLE server static key (IX "
+        "handshake) so clients can pin it across restarts; omitted = "
+        "fresh identity per start. The public key is printed either way.",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -71,11 +77,25 @@ def main(argv=None) -> int:
         expiry_period=args.expiry_period,
         batch_size=args.batch_size,
     )
-    server = GrapevineServer(config, seed=args.seed, max_wait_ms=args.batch_wait_ms)
+    identity = None
+    if args.identity_seed:
+        try:
+            seed_bytes = bytes.fromhex(args.identity_seed)
+        except ValueError:
+            raise SystemExit("--identity-seed must be hex") from None
+        from ..session.channel import ServerIdentity
+
+        identity = ServerIdentity.from_seed(seed_bytes)
+    server = GrapevineServer(
+        config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
+        identity=identity,
+    )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None
     port = server.start(args.listen, tls_cert=tls_cert, tls_key=tls_key)
     print(f"grapevine-tpu listening on port {port}", flush=True)
+    # the pinnable IX static (clients: GrapevineClient(server_static=...))
+    print(f"server static key: {server.identity.public.hex()}", flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:

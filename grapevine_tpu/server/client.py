@@ -15,19 +15,29 @@ import threading
 import grpc
 
 from ..session import channel as chan
-from ..session import ristretto
 from ..session.chacha import ChallengeRng
 from ..wire import constants as C
 from ..wire import protowire as pw
 from ..wire.records import QueryRequest, QueryResponse, RequestRecord
-from .service import SERVICE_NAME
+from .uri import SERVICE_NAME
 from .uri import GrapevineUri
 
 
 class GrapevineClient:
-    def __init__(self, uri: str | GrapevineUri, identity_seed: bytes, root_certs: bytes | None = None):
+    def __init__(
+        self,
+        uri: str | GrapevineUri,
+        identity_seed: bytes,
+        root_certs: bytes | None = None,
+        signature_scheme: str = "schnorrkel",
+        server_static: bytes | None = None,
+        client_static=None,
+    ):
         self.uri = uri if isinstance(uri, GrapevineUri) else GrapevineUri.parse(uri)
-        self.sk, self.public_key = ristretto.keygen(identity_seed)
+        from ..session import get_signature_scheme
+
+        self._scheme = get_signature_scheme(signature_scheme)
+        self.sk, self.public_key = self._scheme.keygen(identity_seed)
         if self.uri.use_tls:
             creds = grpc.ssl_channel_credentials(root_certificates=root_certs)
             self._grpc = grpc.secure_channel(self.uri.address, creds)
@@ -43,6 +53,11 @@ class GrapevineClient:
         self._channel: chan.SecureChannel | None = None
         self._challenge: ChallengeRng | None = None
         self._channel_id = b""
+        #: pinned server static (IX): auth() rejects a server whose
+        #: handshake-authenticated static differs (MITM detection)
+        self._server_static = server_static
+        #: optional client static X25519 private key (IX initiator s)
+        self._client_static = client_static
         # challenge draw + AEAD counters + wire round-trip must stay
         # ordered: an overtaking request desyncs the server's lockstep
         # challenge RNG permanently (reference README.md:195-196)
@@ -57,13 +72,16 @@ class GrapevineClient:
         request would otherwise mix the old challenge RNG with the new
         channel and permanently desync the server's lockstep RNG.
         """
-        priv, pub = chan.client_handshake()
+        state, msg1 = chan.client_handshake(self._client_static)
         with self._lock:
             reply = pw.decode_auth_with_seed(
-                self._auth_rpc(pw.encode_auth_message(pw.AuthMessage(data=pub)))
+                self._auth_rpc(pw.encode_auth_message(pw.AuthMessage(data=msg1)))
             )
             self._channel = chan.client_finish(
-                priv, reply.auth_message.data, attestation
+                state,
+                reply.auth_message.data,
+                attestation,
+                expected_server_static=self._server_static,
             )
             payload = self._channel.decrypt(reply.encrypted_challenge_seed)
             # seed (32) ‖ server-assigned session token (the channel id)
@@ -77,7 +95,7 @@ class GrapevineClient:
         with self._lock:
             challenge = self._challenge.next_challenge()
             req.auth_identity = self.public_key
-            req.auth_signature = ristretto.sign(
+            req.auth_signature = self._scheme.sign(
                 self.sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
             )
             ciphertext = self._channel.encrypt(req.pack())

@@ -22,10 +22,10 @@ import time
 from concurrent.futures import Future
 
 from ..engine.batcher import GrapevineEngine
-from ..session import ristretto
+from ..session import schnorrkel
 from ..wire.records import QueryRequest, QueryResponse
 
-#: (pub, context, message, signature) as taken by ristretto.verify
+#: (pub, context, message, signature) as taken by the scheme's verify
 AuthItem = tuple[bytes, bytes, bytes, bytes]
 
 
@@ -40,12 +40,17 @@ class BatchScheduler:
         max_wait_ms: float = 8.0,
         idle_gap_ms: float = 2.0,
         clock=None,
+        scheme=None,
     ):
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
         self.idle_gap = idle_gap_ms / 1000.0
         self.clock = clock or (lambda: int(time.time()))
+        #: signature scheme module (sign/verify/batch_verify); default is
+        #: the reference-compatible sr25519 (session/schnorrkel.py)
+        self.scheme = scheme or schnorrkel
         self._queue: list[tuple[QueryRequest, AuthItem | None, Future]] = []
+        self._inflight: list[Future] = []
         self._last_enqueue = 0.0
         self._cv = threading.Condition()
         self._closed = False
@@ -70,6 +75,28 @@ class BatchScheduler:
         return fut.result()
 
     def _run(self):
+        """Collector loop wrapper: a crash in the loop must not strand
+        blocked submitters (ADVICE r3: submit() waits on fut.result()
+        with no timeout — a dead worker meant a hung client forever).
+        Fail every queued and in-flight future, then re-raise so the
+        death is loud in logs; subsequent submits raise immediately."""
+        try:
+            self._run_inner()
+        except BaseException as exc:
+            with self._cv:
+                self._closed = True
+                stranded = [fut for _, _, fut in self._queue]
+                self._queue.clear()
+                self._cv.notify_all()
+            stranded += self._inflight
+            for fut in stranded:
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"scheduler worker died: {exc!r}")
+                    )
+            raise
+
+    def _run_inner(self):
         bs = self.engine.ecfg.batch_size
         prev = None  # in-flight (PendingRound, live futures) — pipeline depth 1
         while True:
@@ -104,6 +131,12 @@ class BatchScheduler:
                         self._cv.wait(timeout=wait_until - now)
                     chunk, self._queue = self._queue[:bs], self._queue[bs:]
 
+            # everything the death-guard must fail if we crash from here:
+            # the round still in flight on the device plus the chunk just
+            # popped off the queue (no longer reachable from _queue)
+            self._inflight = ([f for _, f in prev[1]] if prev else []) + [
+                f for _, _, f in chunk
+            ]
             pending, live = (None, [])
             if chunk:
                 live = self._verify_chunk(chunk)
@@ -130,7 +163,7 @@ class BatchScheduler:
         # --- one multi-scalar multiplication for the round ------------
         authed = [i for i, (_, a, _) in enumerate(chunk) if a is not None]
         rejected: set[int] = set()
-        if authed and not ristretto.batch_verify(
+        if authed and not self.scheme.batch_verify(
             [chunk[i][1] for i in authed]
         ):
             # bisect to the offenders: O(bad · log n) batch checks, so
@@ -145,12 +178,12 @@ class BatchScheduler:
                         continue
                     if len(half) == 1:
                         i = half[0]
-                        if not ristretto.verify(*chunk[i][1]):
+                        if not self.scheme.verify(*chunk[i][1]):
                             rejected.add(i)
                             chunk[i][2].set_exception(
                                 AuthFailure("bad challenge signature")
                             )
-                    elif not ristretto.batch_verify(
+                    elif not self.scheme.batch_verify(
                         [chunk[i][1] for i in half]
                     ):
                         stack.append(half)

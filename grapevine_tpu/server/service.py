@@ -29,7 +29,6 @@ import grpc
 from ..config import GrapevineConfig
 from ..engine.batcher import GrapevineEngine, validate_request
 from ..session import channel as chan
-from ..session import ristretto
 from ..session.chacha import ChallengeRng
 from ..testing.reference import HardProtocolError
 from ..wire import constants as C
@@ -39,7 +38,7 @@ from .scheduler import AuthFailure, BatchScheduler
 
 log = logging.getLogger("grapevine_tpu.server")
 
-SERVICE_NAME = "grapevine.GrapevineAPI"
+from .uri import SERVICE_NAME  # noqa: E402  (re-export, see uri.py)
 
 
 #: bytes appended to the challenge seed inside the Auth ciphertext: the
@@ -70,12 +69,23 @@ class GrapevineServer:
         clock=None,
         session_ttl: float = 3600.0,
         max_sessions: int = 4096,
+        identity: chan.ServerIdentity | None = None,
     ):
         self.config = config or GrapevineConfig()
         self.engine = GrapevineEngine(self.config, seed=seed)
         sched_kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
-        self.scheduler = BatchScheduler(self.engine, clock=clock, **sched_kwargs)
+        from ..session import get_signature_scheme
+
+        self.scheduler = BatchScheduler(
+            self.engine,
+            clock=clock,
+            scheme=get_signature_scheme(self.config.signature_scheme),
+            **sched_kwargs,
+        )
         self.attestation = attestation or chan.NullAttestation()
+        #: IX responder static; ``server.identity.public`` is what
+        #: clients pin via ``expected_server_static`` (SECURITY.md)
+        self.identity = identity or chan.ServerIdentity.generate()
         self._sessions: dict[bytes, _Session] = {}
         self._sessions_lock = threading.Lock()
         self.session_ttl = session_ttl
@@ -91,7 +101,7 @@ class GrapevineServer:
         try:
             auth_msg = pw.decode_auth_message(request_bytes)
             reply, secure_channel = chan.server_handshake(
-                auth_msg.data, self.attestation
+                auth_msg.data, self.attestation, identity=self.identity
             )
         except ValueError as exc:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"handshake: {exc}")

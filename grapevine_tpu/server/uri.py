@@ -10,6 +10,10 @@ from __future__ import annotations
 import dataclasses
 from urllib.parse import urlparse
 
+#: gRPC service name (reference grapevine.proto:10); lives here so the
+#: jax-free client library can import it without touching the engine
+SERVICE_NAME = "grapevine.GrapevineAPI"
+
 SCHEME_SECURE = "grapevine"
 SCHEME_INSECURE = "insecure-grapevine"
 DEFAULT_SECURE_PORT = 443

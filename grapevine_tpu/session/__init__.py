@@ -6,9 +6,13 @@ grapevine.proto:17-36 and README.md:177-199, SURVEY.md §2b):
 
 - :mod:`chacha`     — ChaCha20 keystream; the per-request challenge RNG
   that client and server advance in lockstep (README.md:195-196).
-- :mod:`ristretto`  — ristretto255 group (pure Python) and Schnorr
+- :mod:`ristretto`  — ristretto255 group (pure Python) and plain Schnorr
   signatures with the ``b"grapevine-challenge"`` signing context
   (reference types/src/lib.rs:13).
+- :mod:`merlin`     — merlin transcripts (STROBE-128 / Keccak-f[1600]),
+  vector-pinned; the transcript layer under sr25519.
+- :mod:`schnorrkel` — sr25519 signatures byte-compatible with the
+  reference's ``sign_schnorrkel`` clients (README.md:193-199).
 - :mod:`channel`    — X25519 + ChaCha20-Poly1305 encrypted channel with a
   pluggable attestation-evidence interface. TPU has no enclave; the
   evidence hook keeps SGX/TDX/none swappable (SURVEY.md §1 layer 2).
@@ -32,3 +36,16 @@ from .channel import (  # noqa: F401
     client_handshake,
     server_handshake,
 )
+
+
+def get_signature_scheme(name: str):
+    """Module with sign/verify/batch_verify/keygen for a scheme name."""
+    if name == "schnorrkel":
+        from . import schnorrkel
+
+        return schnorrkel
+    if name == "rfc9496":
+        from . import ristretto
+
+        return ristretto
+    raise ValueError(f"unknown signature scheme {name!r}")

@@ -1,24 +1,48 @@
-"""Encrypted session channel: X25519 handshake + ChaCha20-Poly1305 frames.
+"""Encrypted session channel: X25519 IX handshake + ChaCha20-Poly1305.
 
 The analog of the reference's attested noise channel (``mc-attest-ake``'s
-IX handshake + ``mc-crypto-noise`` cipher states; reference
-grapevine.proto:10-15, README.md:177-183). The handshake is
-ephemeral-ephemeral X25519 with HKDF-SHA256 key derivation and directional
-ChaCha20-Poly1305 cipher states with counter nonces.
+Noise **IX** handshake + ``mc-crypto-noise`` cipher states; reference
+grapevine.proto:10-15, README.md:177-183). Like IX, both sides' static
+keys are authenticated *inside* the handshake:
+
+- message 1 (client → server): ``e_c ‖ s_c`` — client ephemeral plus
+  client static (all-zero s_c = anonymous client; per-request identity
+  still comes from the sr25519 challenge signatures either way);
+- message 2 (server → client): ``e_r ‖ AEAD(k_h, s_r ‖ evidence)`` —
+  server ephemeral, then the server *static* and attestation evidence
+  encrypted under a key derived from the ephemeral-ephemeral secret and
+  bound to the transcript hash as AAD;
+- channel keys = HKDF(ee ‖ es ‖ se, salt = transcript hash): the
+  server can only derive them by owning ``s_r`` (es), and a client that
+  sent a static can only derive them by owning ``s_c`` (se) — the IX
+  mutual-authentication property. An active MITM that substitutes
+  either static changes the transcript and the DH mix; the first frame
+  on the channel fails AEAD (tests/test_ix_handshake.py MITM tests).
+
+Server identity policy is the caller's: clients pin the expected server
+static (``expected_server_static=``) and/or verify attestation evidence
+bound to (static, transcript). With ``NullAttestation`` and no pinning,
+``insecure-grapevine://`` sessions are confidential against passive
+observers only — stated in SECURITY.md.
 
 Attestation is a pluggable evidence interface: TPU offers no SGX-style
 remote attestation, so :class:`NullAttestation` ships empty evidence and
 accepts peers — the interface point is kept so SGX/TDX/vTPM evidence can
 slot in without touching the protocol (SURVEY.md §1 layer-2 mapping).
+Evidence is *transcript-bound*: ``verify(evidence, binding=...)``
+receives the hash covering both handshake messages and the server
+static, so real evidence can sign it and preclude evidence replay.
 
 Auth RPC wire shape (mirrors AuthMessageWithChallengeSeed,
-grapevine.proto:26-36): the server's handshake reply carries its ephemeral
-public key + evidence, and the 32-byte challenge seed travels only as
-ciphertext under the freshly established channel.
+grapevine.proto:26-36): the server's handshake reply carries its
+handshake message + evidence, and the 32-byte challenge seed travels
+only as ciphertext under the freshly established channel.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import os
 import struct
 
@@ -30,16 +54,19 @@ from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 from cryptography.hazmat.primitives import hashes
 
-_HKDF_INFO = b"grapevine-tpu-channel-v1"
+_HKDF_INFO = b"grapevine-tpu-channel-ix-v1"
+_HS_INFO = b"grapevine-tpu-ix-handshake"
+_PROTO_TAG = b"grapevine-tpu-ix-v1"
+_ZERO32 = b"\x00" * 32
 
 
 class NullAttestation:
     """No-enclave evidence provider: empty evidence, accepts all peers."""
 
-    def evidence(self) -> bytes:
+    def evidence(self, binding: bytes = b"") -> bytes:
         return b""
 
-    def verify(self, evidence: bytes) -> bool:
+    def verify(self, evidence: bytes, binding: bytes = b"") -> bool:
         return True
 
 
@@ -67,54 +94,158 @@ class SecureChannel:
         return pt
 
 
-def _derive(shared: bytes, transcript: bytes) -> tuple[bytes, bytes]:
+def _derive_channel(
+    ee: bytes, es: bytes, se: bytes, transcript: bytes
+) -> tuple[bytes, bytes]:
+    """(k_c2s, k_s2c) from the concatenated DH outputs + transcript."""
     okm = HKDF(
         algorithm=hashes.SHA256(), length=64, salt=transcript, info=_HKDF_INFO
-    ).derive(shared)
+    ).derive(ee + es + se)
     return okm[:32], okm[32:]
 
 
-def client_handshake():
-    """Start a handshake: returns (state, first_message_bytes)."""
-    priv = X25519PrivateKey.generate()
-    pub = priv.public_key().public_bytes_raw()
-    return priv, pub
+def _hs_key(ee: bytes, transcript: bytes) -> bytes:
+    """Handshake-message key: encrypts the server static + evidence."""
+    return HKDF(
+        algorithm=hashes.SHA256(), length=32, salt=transcript, info=_HS_INFO
+    ).derive(ee)
 
 
-def client_finish(priv: X25519PrivateKey, server_msg: bytes, attestation=None):
+class ServerIdentity:
+    """The server's static X25519 keypair (the IX responder static)."""
+
+    def __init__(self, priv: X25519PrivateKey):
+        self._priv = priv
+        self.public = priv.public_key().public_bytes_raw()
+
+    @classmethod
+    def generate(cls) -> "ServerIdentity":
+        return cls(X25519PrivateKey.generate())
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "ServerIdentity":
+        if len(seed) != 32:
+            raise ValueError("identity seed must be 32 bytes")
+        # domain-separate so a leaked channel seed never doubles as a key
+        key = hashlib.sha256(b"grapevine-tpu-server-static" + seed).digest()
+        return cls(X25519PrivateKey.from_private_bytes(key))
+
+
+@dataclasses.dataclass
+class ClientHandshake:
+    """Client-side handshake state between message 1 and message 2."""
+
+    eph_priv: X25519PrivateKey
+    static_priv: X25519PrivateKey | None
+    msg1: bytes
+
+
+def client_handshake(client_static: X25519PrivateKey | None = None):
+    """Start an IX handshake: returns (state, first_message_bytes).
+
+    ``client_static`` authenticates the client inside the handshake
+    (the IX ``s``/``se`` tokens); None sends the all-zero placeholder —
+    an anonymous client, still request-authenticated via sr25519.
+    """
+    eph = X25519PrivateKey.generate()
+    s_pub = (
+        client_static.public_key().public_bytes_raw()
+        if client_static is not None
+        else _ZERO32
+    )
+    msg1 = eph.public_key().public_bytes_raw() + s_pub
+    return ClientHandshake(eph, client_static, msg1), msg1
+
+
+def client_finish(
+    state: ClientHandshake,
+    server_msg: bytes,
+    attestation=None,
+    expected_server_static: bytes | None = None,
+):
     """Complete the handshake from the server's reply.
 
-    ``server_msg`` = server ephemeral pub (32) ‖ evidence. Returns a
-    :class:`SecureChannel` (client perspective).
+    ``server_msg`` = ``e_r (32) ‖ AEAD(k_h, s_r ‖ evidence)``. Verifies
+    the transcript-bound AEAD, optionally pins the server static, and
+    hands the evidence (with its transcript binding) to ``attestation``.
+    Returns a :class:`SecureChannel`; the authenticated server static is
+    exposed as ``channel.peer_static``.
     """
     attestation = attestation or NullAttestation()
-    if len(server_msg) < 32:
+    if len(server_msg) < 32 + 32 + 16:  # e_r + AEAD(s_r) at minimum
         raise ValueError("short handshake reply")
-    server_pub, evidence = server_msg[:32], server_msg[32:]
-    if not attestation.verify(evidence):
+    e_r, ct = server_msg[:32], server_msg[32:]
+    transcript1 = hashlib.sha256(_PROTO_TAG + state.msg1 + e_r).digest()
+    ee = state.eph_priv.exchange(X25519PublicKey.from_public_bytes(e_r))
+    try:
+        inner = ChaCha20Poly1305(_hs_key(ee, transcript1)).decrypt(
+            b"\x00" * 12, ct, transcript1
+        )
+    except Exception:
+        raise ValueError("handshake reply failed authentication") from None
+    s_r, evidence = inner[:32], inner[32:]
+    if expected_server_static is not None and s_r != expected_server_static:
+        raise ValueError("server static key does not match the pinned key")
+    # the evidence binding covers both handshake messages AND the server
+    # static, and is the SAME value the server signed over — a real
+    # provider signs binding, the verifier checks that signature against
+    # an identical binding (evidence itself excluded: the signer cannot
+    # sign a hash of its own signature)
+    binding = hashlib.sha256(transcript1 + s_r).digest()
+    if not attestation.verify(evidence, binding=binding):
         raise ValueError("attestation evidence rejected")
-    shared = priv.exchange(X25519PublicKey.from_public_bytes(server_pub))
-    transcript = priv.public_key().public_bytes_raw() + server_pub
-    k_c2s, k_s2c = _derive(shared, transcript)
-    return SecureChannel(send_key=k_c2s, recv_key=k_s2c)
+    transcript2 = hashlib.sha256(transcript1 + s_r + evidence).digest()
+    es = state.eph_priv.exchange(X25519PublicKey.from_public_bytes(s_r))
+    se = (
+        state.static_priv.exchange(X25519PublicKey.from_public_bytes(e_r))
+        if state.static_priv is not None
+        else b""
+    )
+    k_c2s, k_s2c = _derive_channel(ee, es, se, transcript2)
+    channel = SecureChannel(send_key=k_c2s, recv_key=k_s2c)
+    channel.peer_static = s_r
+    return channel
 
 
-def server_handshake(client_msg: bytes, attestation=None):
+def server_handshake(client_msg: bytes, attestation=None, identity=None):
     """Server side: returns (reply_bytes, channel).
 
-    ``client_msg`` = client ephemeral pub (32). The reply embeds this
-    side's ephemeral pub + attestation evidence.
+    ``client_msg`` = ``e_c (32) ‖ s_c (32)`` (s_c all-zero = anonymous).
+    ``identity`` is the server's :class:`ServerIdentity`; generated
+    fresh when omitted (callers wanting a stable, pinnable identity
+    pass one — GrapevineServer does). The claimed client static is
+    exposed as ``channel.peer_static`` (None when anonymous); its
+    ownership is proven by the ``se`` mix — a liar cannot decrypt
+    anything on the resulting channel.
     """
     attestation = attestation or NullAttestation()
-    if len(client_msg) != 32:
-        raise ValueError("handshake message must be a 32-byte public key")
-    priv = X25519PrivateKey.generate()
-    pub = priv.public_key().public_bytes_raw()
-    shared = priv.exchange(X25519PublicKey.from_public_bytes(client_msg))
-    transcript = client_msg + pub
-    k_c2s, k_s2c = _derive(shared, transcript)
+    identity = identity or ServerIdentity.generate()
+    if len(client_msg) != 64:
+        raise ValueError("handshake message must be e_c(32) ‖ s_c(32)")
+    e_c, s_c = client_msg[:32], client_msg[32:]
+    eph = X25519PrivateKey.generate()
+    e_r = eph.public_key().public_bytes_raw()
+    transcript1 = hashlib.sha256(_PROTO_TAG + client_msg + e_r).digest()
+    ee = eph.exchange(X25519PublicKey.from_public_bytes(e_c))
+    # same binding the client verifies against: msg1 ‖ e_r ‖ s_r
+    evidence = attestation.evidence(
+        binding=hashlib.sha256(transcript1 + identity.public).digest()
+    )
+    inner = identity.public + evidence
+    ct = ChaCha20Poly1305(_hs_key(ee, transcript1)).encrypt(
+        b"\x00" * 12, inner, transcript1
+    )
+    transcript2 = hashlib.sha256(transcript1 + identity.public + evidence).digest()
+    es = identity._priv.exchange(X25519PublicKey.from_public_bytes(e_c))
+    se = (
+        eph.exchange(X25519PublicKey.from_public_bytes(s_c))
+        if s_c != _ZERO32
+        else b""
+    )
+    k_c2s, k_s2c = _derive_channel(ee, es, se, transcript2)
     channel = SecureChannel(send_key=k_s2c, recv_key=k_c2s)
-    return pub + attestation.evidence(), channel
+    channel.peer_static = None if s_c == _ZERO32 else s_c
+    return e_r + ct, channel
 
 
 def new_challenge_seed() -> bytes:

@@ -220,32 +220,37 @@ def sign(sk: bytes, context: bytes, message: bytes) -> bytes:
     return big_r + s.to_bytes(32, "little")
 
 
-def verify(pub: bytes, context: bytes, message: bytes, signature: bytes) -> bool:
-    """True iff the signature is valid. Never raises on malformed input.
+def verify_core(pub: bytes, r_enc: bytes, s: int, k: int) -> bool:
+    """Scheme-independent single-signature check: s·B == R + k·A.
 
-    Dispatches to the native ristretto255 library when available
-    (grapevine_tpu/native, ~0.1 ms/verify) with this pure-Python path as
-    the fallback and the correctness oracle (tests/test_native_r255.py
-    cross-checks the two)."""
+    Native library when available (~0.1 ms/verify), pure Python as the
+    fallback and correctness oracle (tests/test_native_r255.py). Shared
+    by this module's plain Schnorr and session/schnorrkel.py — the
+    schemes differ only in how k is derived and how s is parsed."""
+    if _native.lib is not None:
+        return (
+            _native.verify1(
+                pub, r_enc, s.to_bytes(32, "little"), k.to_bytes(32, "little")
+            )
+            == 1
+        )
+    try:
+        big_r = RistrettoPoint.decode(r_enc)
+        a_pt = _decode_pub_cached(pub)
+    except ValueError:
+        return False
+    return _fixed_base_mult(s) == (big_r + k * a_pt)
+
+
+def verify(pub: bytes, context: bytes, message: bytes, signature: bytes) -> bool:
+    """True iff the signature is valid. Never raises on malformed input."""
     if len(signature) != 64 or len(pub) != 32:
         return False
     s = int.from_bytes(signature[32:], "little")
     if s >= L:
         return False
     k = _h_scalar(_CHAL_DOMAIN, context, signature[:32], pub, message)
-    if _native.lib is not None:
-        return (
-            _native.verify1(
-                pub, signature[:32], signature[32:], k.to_bytes(32, "little")
-            )
-            == 1
-        )
-    try:
-        big_r = RistrettoPoint.decode(signature[:32])
-        a_pt = _decode_pub_cached(pub)
-    except ValueError:
-        return False
-    return _fixed_base_mult(s) == (big_r + k * a_pt)
+    return verify_core(pub, signature[:32], s, k)
 
 
 # -- batch verification (one multi-scalar multiplication per round) ----
@@ -324,27 +329,33 @@ def _msm(points: list[RistrettoPoint], scalars: list[int]) -> RistrettoPoint:
     return acc
 
 
-def batch_verify(
-    items: list[tuple[bytes, bytes, bytes, bytes]],
+def batch_verify_core(
+    parsed: list[tuple[bytes, bytes, int, int]],
     rng=None,
 ) -> bool:
-    """True iff EVERY (pub, context, message, signature) verifies.
+    """Random-linear-combination batch check over pre-parsed items.
 
-    One multi-scalar multiplication for the whole batch (native library
-    when available: ~0.05 ms/signature at batch 64). On False the caller
-    falls back to per-item verify to identify offenders. ``rng`` must be
-    unpredictable to clients (default: os.urandom)."""
+    ``parsed`` holds (R_enc, pub_enc, s, k) per signature — the scheme
+    layer (this module's plain Schnorr, or session/schnorrkel.py's
+    merlin-transcript challenge) computes k; the group equation
+
+        Σ z_i·s_i · B  ==  Σ z_i·R_i + Σ (z_i·k_i mod L)·A_i
+
+    is scheme-independent. Shared so both schemes ride the same native
+    one-MSM path. ``rng`` must be unpredictable to clients."""
     import os
 
     # the native MSM scratch caps one call at _NATIVE_CHUNK items; larger
     # batches split into independently-checked chunks (each chunk is its
     # own random-linear-combination equation), so there is no silent
     # fallback cliff at any batch size
-    if len(items) > _NATIVE_CHUNK:
+    if len(parsed) > _NATIVE_CHUNK:
         return all(
-            batch_verify(items[i : i + _NATIVE_CHUNK], rng)
-            for i in range(0, len(items), _NATIVE_CHUNK)
+            batch_verify_core(parsed[i : i + _NATIVE_CHUNK], rng)
+            for i in range(0, len(parsed), _NATIVE_CHUNK)
         )
+    if not parsed:
+        return True
 
     randbytes = rng.randbytes if rng is not None else os.urandom
     use_native = _native.lib is not None
@@ -355,23 +366,17 @@ def batch_verify(
     points: list[RistrettoPoint] = []
     scalars: list[int] = []
     sb = 0
-    for pub, context, message, signature in items:
-        if len(signature) != 64 or len(pub) != 32:
-            return False
-        s = int.from_bytes(signature[32:], "little")
-        if s >= L:
-            return False
+    for r_enc, pub, s, k in parsed:
         if not use_native:
             try:
-                points.append(RistrettoPoint.decode(signature[:32]))
+                points.append(RistrettoPoint.decode(r_enc))
                 points.append(_decode_pub_cached(pub))
             except ValueError:
                 return False
-        k = _h_scalar(_CHAL_DOMAIN, context, signature[:32], pub, message)
         z = int.from_bytes(randbytes(16), "little") | 1
         sb = (sb + z * s) % L
         if use_native:
-            rs.append(signature[:32])
+            rs.append(r_enc)
             pubs.append(pub)
             zs.append(z.to_bytes(32, "little"))
             zks.append((z * k % L).to_bytes(32, "little"))
@@ -379,8 +384,6 @@ def batch_verify(
             scalars.append(z)
             scalars.append(z * k % L)
     if use_native:
-        if not items:
-            return True
         return (
             _native.batch_check(
                 b"".join(rs),
@@ -392,3 +395,25 @@ def batch_verify(
             == 1
         )
     return _fixed_base_mult(sb) == _msm(points, scalars)
+
+
+def batch_verify(
+    items: list[tuple[bytes, bytes, bytes, bytes]],
+    rng=None,
+) -> bool:
+    """True iff EVERY (pub, context, message, signature) verifies.
+
+    One multi-scalar multiplication for the whole batch (native library
+    when available: ~0.05 ms/signature at batch 64). On False the caller
+    falls back to per-item verify to identify offenders. ``rng`` must be
+    unpredictable to clients (default: os.urandom)."""
+    parsed = []
+    for pub, context, message, signature in items:
+        if len(signature) != 64 or len(pub) != 32:
+            return False
+        s = int.from_bytes(signature[32:], "little")
+        if s >= L:
+            return False
+        k = _h_scalar(_CHAL_DOMAIN, context, signature[:32], pub, message)
+        parsed.append((signature[:32], pub, s, k))
+    return batch_verify_core(parsed, rng)

@@ -193,7 +193,7 @@ def test_scheduler_bisection_rejects_only_bad_signatures():
     from grapevine_tpu.config import GrapevineConfig
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.server.scheduler import AuthFailure, BatchScheduler
-    from grapevine_tpu.session import ristretto
+    from grapevine_tpu.session import schnorrkel
     from grapevine_tpu.wire import constants as C
     from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
@@ -210,10 +210,11 @@ def test_scheduler_bisection_rejects_only_bad_signatures():
         results: dict[int, object] = {}
 
         def submit(i, good):
-            sk, pub = ristretto.keygen(bytes([i + 1]) * 32)
+            # sign with the scheduler's default scheme (sr25519)
+            sk, pub = schnorrkel.keygen(bytes([i + 1]) * 32)
             msg = bytes([i]) * 32
             sig = (
-                ristretto.sign(sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, msg)
+                schnorrkel.sign(sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, msg)
                 if good
                 else b"\x42" * 64
             )
@@ -257,7 +258,6 @@ def test_replayed_and_injected_envelopes_do_not_desync_session(server):
     consuming a lockstep challenge or advancing cipher state — otherwise
     one injected request permanently desyncs the legitimate client
     (an injection-DoS; see service._query). The session keeps working."""
-    from grapevine_tpu.session import ristretto
     from grapevine_tpu.wire import protowire as pw
     from grapevine_tpu.wire.records import QueryRequest, RequestRecord
 
@@ -270,7 +270,7 @@ def test_replayed_and_injected_envelopes_do_not_desync_session(server):
     req = QueryRequest(
         request_type=C.REQUEST_TYPE_CREATE,
         auth_identity=c.public_key,
-        auth_signature=ristretto.sign(
+        auth_signature=c._scheme.sign(
             c.sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
         ),
         record=RequestRecord(
