@@ -563,9 +563,15 @@ def main():
     results: dict = {}
     meta: dict = {"sizes": "smoke" if smoke else "full"}
     strict_smoke = smoke
+    # a parseable line exists from t=0 — BEFORE anything imports jax in
+    # this process: a wedged site hook can stall `import jax` itself for
+    # minutes (the r3 empty-artifact failure mode), and the emit must
+    # not be behind that risk
+    _emit(results, meta)
     if smoke:
         # smoke mode must not grab (or wait on) TPU hardware; the env var
         # alone loses to platform-pinning plugin hooks, so pin via config
+        os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -580,6 +586,8 @@ def main():
             meta.update(backend="cpu-fallback", probe_error=err,
                         sizes="smoke")
             smoke = True
+            _emit(results, meta)  # fallback line lands pre-import too
+            os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
 
             jax.config.update("jax_platforms", "cpu")
@@ -587,7 +595,7 @@ def main():
                   file=sys.stderr, flush=True)
         else:
             meta["backend"] = backend
-    _emit(results, meta)  # a parseable line exists from t=0
+    _emit(results, meta)
     for name, fn in CONFIGS:
         elapsed = time.perf_counter() - t_start
         if elapsed > budget_s:

@@ -79,13 +79,14 @@ def main(argv=None) -> int:
     )
     identity = None
     if args.identity_seed:
-        try:
-            seed_bytes = bytes.fromhex(args.identity_seed)
-        except ValueError:
-            raise SystemExit("--identity-seed must be hex") from None
         from ..session.channel import ServerIdentity
 
-        identity = ServerIdentity.from_seed(seed_bytes)
+        try:
+            identity = ServerIdentity.from_seed(bytes.fromhex(args.identity_seed))
+        except ValueError as exc:
+            raise SystemExit(
+                f"--identity-seed must be 64 hex chars (32 bytes): {exc}"
+            ) from None
     server = GrapevineServer(
         config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
         identity=identity,

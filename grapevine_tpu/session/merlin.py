@@ -130,26 +130,43 @@ class Strobe128:
         self.pos = 0
         self.pos_begin = 0
 
+    # the duplex ops work in rate-bounded slices, not per byte: the
+    # transcript layer sits on the per-request signature hot path, and a
+    # byte-at-a-time loop costs tens of µs per signature for no reason
+
     def _absorb(self, data: bytes) -> None:
-        for byte in data:
-            self.state[self.pos] ^= byte
-            self.pos += 1
+        i, n, st = 0, len(data), self.state
+        while i < n:
+            take = min(_STROBE_R - self.pos, n - i)
+            p = self.pos
+            st[p : p + take] = (
+                int.from_bytes(st[p : p + take], "little")
+                ^ int.from_bytes(data[i : i + take], "little")
+            ).to_bytes(take, "little")
+            self.pos += take
+            i += take
             if self.pos == _STROBE_R:
                 self._run_f()
 
     def _overwrite(self, data: bytes) -> None:
-        for byte in data:
-            self.state[self.pos] = byte
-            self.pos += 1
+        i, n, st = 0, len(data), self.state
+        while i < n:
+            take = min(_STROBE_R - self.pos, n - i)
+            st[self.pos : self.pos + take] = data[i : i + take]
+            self.pos += take
+            i += take
             if self.pos == _STROBE_R:
                 self._run_f()
 
     def _squeeze(self, n: int) -> bytes:
         out = bytearray(n)
-        for i in range(n):
-            out[i] = self.state[self.pos]
-            self.state[self.pos] = 0
-            self.pos += 1
+        i, st = 0, self.state
+        while i < n:
+            take = min(_STROBE_R - self.pos, n - i)
+            out[i : i + take] = st[self.pos : self.pos + take]
+            st[self.pos : self.pos + take] = bytes(take)
+            self.pos += take
+            i += take
             if self.pos == _STROBE_R:
                 self._run_f()
         return bytes(out)

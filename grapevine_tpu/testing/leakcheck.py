@@ -86,6 +86,45 @@ def twosample_z(
     return (chi2 - dof) / np.sqrt(2 * dof)
 
 
+def timing_twosample_z(times_a: np.ndarray, times_b: np.ndarray) -> float:
+    """Mann-Whitney U z-score between two round wall-time samples.
+
+    The obliviousness invariant covers *timing* (reference
+    grapevine.proto:120-122: "access patterns and timings"): rounds of
+    different op mixes must draw round times from one distribution.
+    Rank-based (robust to scheduler outliers), tie-corrected normal
+    approximation — identical distributions give z ~ N(0,1); an
+    op-type-dependent cost shows up as |z| growing like sqrt(N).
+    Callers should *interleave* the two conditions in measurement order
+    so host load drift hits both samples equally.
+    """
+    a = np.asarray(times_a, float).ravel()
+    b = np.asarray(times_b, float).ravel()
+    n1, n2 = a.size, b.size
+    if n1 == 0 or n2 == 0:
+        return 0.0
+    combined = np.concatenate([a, b])
+    order = np.argsort(combined, kind="mergesort")
+    ranks = np.empty_like(combined)
+    ranks[order] = np.arange(1, n1 + n2 + 1, dtype=float)
+    # average ranks over ties
+    uniq, inv, counts = np.unique(
+        combined, return_inverse=True, return_counts=True
+    )
+    sums = np.zeros(uniq.size)
+    np.add.at(sums, inv, ranks)
+    ranks = sums[inv] / counts[inv]
+    r1 = float(ranks[:n1].sum())
+    u1 = r1 - n1 * (n1 + 1) / 2.0
+    n = n1 + n2
+    mu = n1 * n2 / 2.0
+    tie_term = float(((counts**3 - counts).sum())) / (n * (n - 1)) if n > 1 else 0.0
+    var = n1 * n2 / 12.0 * ((n + 1) - tie_term)
+    if var <= 0:
+        return 0.0
+    return (u1 - mu) / np.sqrt(var)
+
+
 def uniformity_z(leaves: np.ndarray, n_leaves: int, bins: int = 16) -> float:
     """Normal-approximated chi-square z-score of the leaf histogram.
 
