@@ -98,39 +98,54 @@ class GrapevineConfig:
             raise ValueError(
                 f"tree_density must be 1, 2, or 4, got {self.tree_density}"
             )
+        if self.mailbox_choices not in (None, 1, 2):
+            raise ValueError(
+                f"mailbox_choices must be None, 1 or 2, got "
+                f"{self.mailbox_choices}"
+            )
+        if self.commit == "op" and self.mailbox_choices == 2:
+            raise ValueError(
+                "commit='op' (the differential-oracle engine) supports "
+                "only mailbox_choices=1"
+            )
+    #: hash choices per recipient in the mailbox table. 2 (default for
+    #: the phase-major engine) = power-of-two-choices: a new recipient
+    #: claims a slot in the emptier of two keyed-hash candidate buckets
+    #: (occupancy read at round start; choice resolved obliviously —
+    #: every op fetches BOTH candidate paths every time, so the
+    #: transcript never reveals which bucket holds a recipient). 1 =
+    #: the round-3 single-choice table (required by the op-major
+    #: ``commit="op"`` differential-oracle engine, which keeps the
+    #: simpler scheme). None = auto: 2 for phase commit, 1 for op.
+    mailbox_choices: int | None = None
+
     #: per-slot load target; table buckets M = ceil(
-    #: max_recipients / (mailbox_slots * mailbox_load)).
+    #: max_recipients / (mailbox_slots * load)). None = auto by choice
+    #: count: 0.5 under two-choice, 0.125 under single-choice.
     #:
-    #: The mailbox tier is a keyed SINGLE-CHOICE hash table of K-slot
-    #: buckets, not the reference's bucketed cuckoo (README.md:78-80).
-    #: The quantified bargain (tests/test_mailbox_load.py):
+    #: The mailbox tier approximates the reference's bucketed-cuckoo map
+    #: (README.md:78-80) with a RELOCATION-FREE two-choice table — no
+    #: eviction chains on device. The quantified bargain
+    #: (tests/test_mailbox_load.py):
     #:
-    #: - **Early failures**: a recipient whose bucket is full gets
-    #:   TOO_MANY_RECIPIENTS before the aggregate cap is reached. With
-    #:   R = fill · max_recipients uniform recipients, per-bucket
-    #:   occupancy is ≈ Poisson(λ = K·load·fill); expected early
-    #:   failures ≈ M · P(X ≥ K+1). At the default (K=4, load=0.125):
-    #:   fill 50% ⇒ λ=0.25, P ≈ 6.6e-6 (≈0.05 expected at M=8192);
-    #:   fill 100% ⇒ λ=0.5, P ≈ 1.7e-4 (≈1.4 expected at M=8192) —
-    #:   i.e. near the aggregate cap, a handful of recipients may be
-    #:   refused early. The spec permits TOO_MANY_RECIPIENTS at any
-    #:   recipient count; the oracle models only the aggregate cap, so
-    #:   randomized oracle-equality suites run at low fill.
+    #: - **Early failures**: a recipient whose candidate bucket(s) are
+    #:   full gets TOO_MANY_RECIPIENTS before the aggregate cap. At
+    #:   K=4: single-choice load 0.125 gives Poisson(λ=0.5) tails —
+    #:   ≈1.4 expected early failures at M=8192, fill 100%. Two-choice
+    #:   at load 0.5 needs BOTH candidates full: simulated (20 trials,
+    #:   M=4096) ≈0 failures through fill 75% and ≈0.3 expected at
+    #:   fill 100% — strictly fewer failures than single-choice at
+    #:   1/4 the bucket count. The spec permits TOO_MANY_RECIPIENTS at
+    #:   any recipient count; the oracle models only the aggregate cap,
+    #:   so randomized oracle-equality suites run at low fill.
     #: - **Memory**: mailbox-tier HBM per recipient is 1/load × the
-    #:   mailbox size — 8× at the default (the price of no relocation).
-    #:   In absolute terms the tier is small: at a 2^20-message bus with
-    #:   2^12 recipients the mailbox tree is ~0.13 GB against the 4 GB
-    #:   records tree (~3% of engine HBM), so the 8× factor costs ~0.11
-    #:   GB — the records tier, not the mailbox tier, bounds capacity.
-    #:
-    #: A relocating scheme (two-choice or cuckoo with bounded-iteration
-    #: masked eviction chains) would shrink the factor to ~2× and kill
-    #: early failures; it costs a second mailbox path fetch per op and a
-    #: substantially hairier within-round claim/occupancy resolution in
-    #: engine/vphases.py. Deliberately deferred: the memory it saves is
-    #: ~3% of the engine while the records tree dominates, and the
-    #: early-failure path is analyzed and tested (test_mailbox_load).
-    mailbox_load: float = 0.125
+    #:   mailbox size — 2× under two-choice vs the reference cuckoo's
+    #:   ~1.2×, and vs 8× for round-3's single-choice table.
+    #: - **Bandwidth**: every op pays a second mailbox path fetch in
+    #:   rounds A and C (both candidates touched unconditionally). The
+    #:   mailbox tree is the small tier, so this trades ~0.3 ms/round
+    #:   of cheap bandwidth for 4× less mailbox HBM.
+    mailbox_load: float | None = None
 
     #: blocks per tree leaf for both ORAMs. The classic Path ORAM shape
     #: is 1 (total slots = 8× blocks — 12.5% utilization); 2 halves tree
@@ -153,10 +168,33 @@ class GrapevineConfig:
         return 1 << self.records_height
 
     @property
+    def resolved_mailbox_choices(self) -> int:
+        """1 or 2: the explicit knob, else 2 for phase / 1 for op."""
+        if self.mailbox_choices is not None:
+            return self.mailbox_choices
+        return 2 if self.commit == "phase" else 1
+
+    @property
+    def resolved_mailbox_load(self) -> float:
+        """Load target: the explicit knob, else by choice count."""
+        if self.mailbox_load is not None:
+            return self.mailbox_load
+        return 0.5 if self.resolved_mailbox_choices == 2 else 0.125
+
+    @property
     def mailbox_table_buckets(self) -> int:
-        """Hash table size (power of two) for the mailbox map."""
+        """Hash table size (power of two) for the mailbox map.
+
+        Floor of 16: keeps the mailbox bucket tree shardable over an
+        8-chip mesh at toy capacities and gives the two-choice hash a
+        meaningful candidate space; the cost at tiny configs is a few
+        KiB."""
         want = max(
-            2, math.ceil(self.max_recipients / (self.mailbox_slots * self.mailbox_load))
+            16,
+            math.ceil(
+                self.max_recipients
+                / (self.mailbox_slots * self.resolved_mailbox_load)
+            ),
         )
         return 1 << max(1, math.ceil(math.log2(want)))
 

@@ -73,17 +73,18 @@ def engine_round_step(
     recipient = batch["recipient"]
     payload = batch["payload"]
 
+    d = ecfg.mb_choices  # candidate buckets fetched per op (mailbox tier)
     keys = jax.random.split(state.rng, 8)
     k_next = keys[7]
     nl_a, nl_b, nl_c = (
-        jax.random.bits(keys[0], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[0], (b * d,), U32) & U32(ecfg.mb.leaves - 1),
         jax.random.bits(keys[1], (b,), U32) & U32(ecfg.rec.leaves - 1),
-        jax.random.bits(keys[2], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[2], (b * d,), U32) & U32(ecfg.mb.leaves - 1),
     )
     dl_a, dl_b, dl_c = (
-        jax.random.bits(keys[3], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[3], (b * d,), U32) & U32(ecfg.mb.leaves - 1),
         jax.random.bits(keys[4], (b,), U32) & U32(ecfg.rec.leaves - 1),
-        jax.random.bits(keys[5], (b,), U32) & U32(ecfg.mb.leaves - 1),
+        jax.random.bits(keys[5], (b * d,), U32) & U32(ecfg.mb.leaves - 1),
     )
     id_rand = jax.random.bits(keys[6], (b, 3), U32)
 
@@ -96,10 +97,22 @@ def engine_round_step(
     zero_recip = is_zero_words(recipient)
 
     ka = jnp.where((is_create | ~id_zero)[:, None], recipient, auth)
-    bucket = jax.vmap(
-        lambda k: mb_bucket_hash(state.hash_key, k, ecfg.mb_table_buckets)
-    )(ka)
-    idxs_mb = jnp.where(is_real, bucket, U32(ecfg.mb.dummy_index))
+    # D candidate buckets per op (salted independent keyed hashes);
+    # every op fetches ALL candidates so the transcript hides which one
+    # holds the recipient (vphases.phase_a_batch chooses with masks)
+    bucket2 = jnp.stack(
+        [
+            jax.vmap(
+                lambda k, c=c: mb_bucket_hash(
+                    state.hash_key, k, ecfg.mb_table_buckets, salt=c
+                )
+            )(ka)
+            for c in range(d)
+        ],
+        axis=1,
+    )  # u32[B,D]
+    idxs_mb2 = jnp.where(is_real[:, None], bucket2, U32(ecfg.mb.dummy_index))
+    idxs_mb_flat = idxs_mb2.reshape(b * d)
 
     # allocation candidates: the top B free blocks, pre-gathered so the
     # freelist array never enters device decision logic (vphases assigns
@@ -118,7 +131,7 @@ def engine_round_step(
         "id_zero": id_zero,
         "zero_recip": zero_recip,
         "ka": ka,
-        "idxs_mb": idxs_mb,
+        "idxs_mb2": idxs_mb2,
         "cand_idx": cand_idx,
         "id_key": state.id_key,
         "id_rand": id_rand,
@@ -132,7 +145,8 @@ def engine_round_step(
         "payload": payload,
     }
     mb1, out_a, leaf_a = oram_round(
-        ecfg.mb, state.mb, idxs_mb, nl_a, dl_a, phase_a_batch(ecfg, ctx), axis_name
+        ecfg.mb, state.mb, idxs_mb_flat, nl_a, dl_a,
+        phase_a_batch(ecfg, ctx), axis_name,
     )
     free_top = state.free_top - out_a["n_allocs"]
     recipients = state.recipients + out_a["n_claims"]
@@ -183,7 +197,8 @@ def engine_round_step(
         "rm_a": out_a["rm_a"],
     }
     mb2, _out_c, leaf_c = oram_round(
-        ecfg.mb, mb1, idxs_mb, nl_c, dl_c, phase_c_batch(ecfg, ctx_c), axis_name
+        ecfg.mb, mb1, idxs_mb_flat, nl_c, dl_c,
+        phase_c_batch(ecfg, ctx_c), axis_name,
     )
 
     # ---- response assembly (shared with the op-major engine) ----------
@@ -202,7 +217,12 @@ def engine_round_step(
         payload=payload,
         now=now,
     )
-    transcripts = jnp.stack([leaf_a, leaf_b, leaf_c], axis=1)
+    # transcript: D leaves per mailbox round + 1 records leaf per op —
+    # [B, 2D+1] columns (a_0..a_{D-1}, b, c_0..c_{D-1}); every entry an
+    # independent uniform draw either way
+    transcripts = jnp.concatenate(
+        [leaf_a.reshape(b, d), leaf_b[:, None], leaf_c.reshape(b, d)], axis=1
+    )
 
     new_state = EngineState(
         rec=rec1,

@@ -69,6 +69,7 @@ class EngineConfig:
     mb: OramConfig
     mb_table_buckets: int
     mb_slots: int  # K mailboxes per hash bucket
+    mb_choices: int = 1  # hash choices per recipient (2 = power-of-two)
 
     @property
     def id_bits(self) -> int:
@@ -106,6 +107,7 @@ class EngineConfig:
             ),
             mb_table_buckets=m,
             mb_slots=k,
+            mb_choices=cfg.resolved_mailbox_choices,
         )
 
 
@@ -154,15 +156,18 @@ def mb_pack(ecfg: EngineConfig, keys: jax.Array, entries: jax.Array) -> jax.Arra
     return flat.reshape(k * (KEY_WORDS + ENTRY_WORDS * cap))
 
 
-def mb_bucket_hash(hash_key: jax.Array, recipient: jax.Array, n_buckets: int):
+def mb_bucket_hash(
+    hash_key: jax.Array, recipient: jax.Array, n_buckets: int, salt: int = 0
+):
     """Keyed PRF: recipient (8 words) → bucket index in [0, n_buckets).
 
     A small ARX/multiply mixer (murmur-style finalizer per word). Secret
     ``hash_key`` keeps bucket choices unpredictable to clients, thwarting
     targeted hash-flooding of one bucket (the analog of the reference's
-    enclave-private hashing).
+    enclave-private hashing). ``salt`` domain-separates the two
+    independent hash functions of the two-choice table (h_c = salt c).
     """
-    h = hash_key[0]
+    h = hash_key[0] ^ jnp.uint32(salt * 0x9E3779B9)
     c1, c2 = jnp.uint32(0xCC9E2D51), jnp.uint32(0x1B873593)
     for w in range(KEY_WORDS):
         x = recipient[..., w] * c1

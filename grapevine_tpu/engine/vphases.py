@@ -248,39 +248,82 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
     """Build the round-A ``apply_batch`` callback.
 
     ``ctx``: is_real/is_create/is_read/is_update/is_delete bool[B],
-    id_zero, zero_recip bool[B]; ka u32[B,8]; idxs_mb u32[B];
-    cand_idx u32[B]; id_rand u32[B,3]; free_top0, recipients0, seq0 u32;
-    now u32. Returns (callback, None); callback returns
-    (out_a, final_val, final_alive)."""
+    id_zero, zero_recip bool[B]; ka u32[B,8]; idxs_mb2 u32[B,D] (the
+    D=ecfg.mb_choices candidate table buckets per op; the round fetches
+    all of them, flattened row-major); cand_idx u32[B]; id_rand u32[B,3];
+    free_top0, recipients0, seq0 u32; now u32. The callback receives
+    [B*D] rows and returns (out_a, final_val [B*D,V], final_alive [B*D]).
+
+    Two-choice (D=2) semantics: an op's *effective* bucket is the
+    candidate containing its recipient key, else — for a fresh claim —
+    the candidate with more free key slots **at round start** (ties →
+    candidate 0). The choice is resolved with masks over both fetched
+    candidates, and both candidate rows are always written back, so the
+    transcript never shows which candidate holds a recipient. Choosing
+    by round-start occupancy (not claim-by-claim) keeps the admission
+    walk vectorized; a claim can still fail if earlier in-round claims
+    fill its chosen bucket — same order-sensitivity class as the
+    existing claim_rank < free_slots0 rule, invisible to the oracle
+    (placement never surfaces in responses)."""
 
     b = ctx["ka"].shape[0]
+    d = ecfg.mb_choices
     k, cap = ecfg.mb_slots, ecfg.mailbox_cap
     is_real = ctx["is_real"]
     is_create_cand = ctx["is_create"] & is_real & ~ctx["zero_recip"]
     is_pop_cand = ctx["is_delete"] & ctx["id_zero"] & is_real
     is_zsel = (ctx["is_read"] | ctx["is_delete"]) & ctx["id_zero"] & is_real
     ka = ctx["ka"]
+    idxs_mb2 = ctx["idxs_mb2"]  # u32[B,D]
     now = ctx["now"]
+    m_sentinel = U32(ecfg.mb_table_buckets)
     iota = jnp.arange(b, dtype=U32)
 
-    # recipient groups (ka equality) and bucket groups (idxs_mb equality)
+    # recipient groups (ka equality); bucket groups move inside the
+    # callback — the effective bucket depends on fetched occupancy
     requal = (
         words_equal(ka[:, None, :], ka[None, :, :])
         & is_real[:, None]
         & is_real[None, :]
     )
     rslot = jnp.where(is_real, jnp.argmax(requal, axis=1).astype(U32), iota)
-    gequal = (
-        (ctx["idxs_mb"][:, None] == ctx["idxs_mb"][None, :])
-        & is_real[:, None]
-        & is_real[None, :]
-    )
-    gslot = jnp.where(is_real, jnp.argmax(gequal, axis=1).astype(U32), iota)
-    glast = jnp.max(jnp.where(gequal, iota[None, :], 0), axis=1)
-    glast = jnp.where(is_real, glast, iota)
 
     def apply_batch(vals0, present0):
-        keys0, entries0 = _mb_parse_batch(ecfg, vals0)
+        # --- candidate choice: [B*D] rows → per-op chosen views -------
+        keys_c, entries_c = _mb_parse_batch(ecfg, vals0)  # [B*D,K,..]
+        keys_c = keys_c.reshape(b, d, k, 8)
+        entries_c = entries_c.reshape(b, d, k, cap, 4)
+        key_valid_c = ~is_zero_words(keys_c)  # [B,D,K]
+        match_c = key_valid_c & words_equal(keys_c, ka[:, None, None, :])
+        found_c = jnp.any(match_c, axis=2)  # [B,D]
+        free_c = (k - jnp.sum(key_valid_c, axis=2)).astype(I32)  # [B,D]
+        if d == 1:
+            chosen = jnp.zeros((b,), I32)
+        else:
+            emptier = jnp.argmax(free_c, axis=1).astype(I32)  # ties → 0
+            chosen = jnp.where(
+                jnp.any(found_c, axis=1),
+                jnp.argmax(found_c, axis=1).astype(I32),
+                emptier,
+            )
+        ch = chosen[:, None, None, None]
+        keys0 = jnp.take_along_axis(keys_c, ch.astype(I32), axis=1)[:, 0]
+        entries0 = jnp.take_along_axis(
+            entries_c, ch[..., None].astype(I32), axis=1
+        )[:, 0]
+        eff_idx = jnp.take_along_axis(idxs_mb2, chosen[:, None], axis=1)[:, 0]
+        eff_idx = jnp.where(is_real, eff_idx, m_sentinel + U32(1) + iota)
+
+        # bucket groups over the effective bucket
+        gequal = (
+            (eff_idx[:, None] == eff_idx[None, :])
+            & is_real[:, None]
+            & is_real[None, :]
+        )
+        gslot = jnp.where(is_real, jnp.argmax(gequal, axis=1).astype(U32), iota)
+        glast = jnp.max(jnp.where(gequal, iota[None, :], 0), axis=1)
+        glast = jnp.where(is_real, glast, iota)
+
         key_valid0 = ~is_zero_words(keys0)  # [B,K]
         slot_match0 = key_valid0 & words_equal(keys0, ka[:, None, :])  # [B,K]
         found0 = jnp.any(slot_match0, axis=1) & is_real
@@ -451,8 +494,26 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         )
         ents_fin = ents_fin.at[etgt].set(new_entry, mode="drop")
 
-        final_val = _mb_pack_batch(ecfg, keys_fin, ents_fin)
-        final_alive = jnp.any(~is_zero_words(keys_fin), axis=1)  # [B]
+        assembled = _mb_pack_batch(ecfg, keys_fin, ents_fin)  # [B,V]
+        assembled_alive = jnp.any(~is_zero_words(keys_fin), axis=1)  # [B]
+
+        # --- row commit: every fetched row of a bucket carries the
+        # bucket's final state (oram_round commits whichever row is the
+        # bucket's LAST occurrence in the flattened [B*D] order — which
+        # may be another op's *unchosen* candidate, so pass-through rows
+        # must hold the committed value too). Dense bucket → last-
+        # choosing-op map: one scatter-max + one gather.
+        op_map = (
+            jnp.full((ecfg.mb_table_buckets + 1,), -1, I32)
+            .at[jnp.where(is_real, eff_idx, m_sentinel + U32(1))]
+            .max(iota.astype(I32), mode="drop")
+        )
+        rows_idx = idxs_mb2.reshape(b * d)
+        g = op_map[jnp.minimum(rows_idx, m_sentinel)]  # [B*D]; -1 = none
+        has_g = (g >= 0) & (rows_idx < m_sentinel)
+        gc = jnp.clip(g, 0, b - 1)
+        final_val = jnp.where(has_g[:, None], assembled[gc], vals0)
+        final_alive = jnp.where(has_g, assembled_alive[gc], present0)
 
         out_a = {
             "create_ok": create_ok,
@@ -586,25 +647,47 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
 
 def phase_c_batch(ecfg: EngineConfig, ctx: dict):
     """Round-C callback. ``ctx`` adds: del_ok, upd_ok, rm_a bool[B] (from
-    rounds A/B), msg_id u32[B,4], ka u32[B,8], idxs_mb u32[B]."""
+    rounds A/B), msg_id u32[B,4], ka u32[B,8], idxs_mb2 u32[B,D].
+
+    Like round A the callback sees all D candidate rows per op; an op's
+    mutations (explicit-delete clear, update timestamp refresh) land in
+    whichever candidate holds its recipient key, and are aggregated onto
+    EVERY fetched row of that bucket so the round's last-occurrence
+    commit (oram_round) writes them regardless of which op's row wins."""
 
     b = ctx["ka"].shape[0]
+    d = ecfg.mb_choices
     k, cap = ecfg.mb_slots, ecfg.mailbox_cap
     is_real = ctx["is_real"]
-    iota = jnp.arange(b, dtype=U32)
-    gequal = (
-        (ctx["idxs_mb"][:, None] == ctx["idxs_mb"][None, :])
-        & is_real[:, None]
-        & is_real[None, :]
-    )
+    idxs_mb2 = ctx["idxs_mb2"]
+    m_sentinel = U32(ecfg.mb_table_buckets)
     rm_c = ctx["del_ok"] & ~ctx["rm_a"] & is_real
     refresh = ctx["upd_ok"] & is_real
     now = ctx["now"]
 
     def apply_batch(vals0, present0):
-        keys0, entries0 = _mb_parse_batch(ecfg, vals0)
-        key_valid0 = ~is_zero_words(keys0)
-        slot_match = key_valid0 & words_equal(keys0, ctx["ka"][:, None, :])  # [B,K]
+        keys_c, entries_c = _mb_parse_batch(ecfg, vals0)
+        keys_c = keys_c.reshape(b, d, k, 8)
+        entries_c = entries_c.reshape(b, d, k, cap, 4)
+        key_valid_c = ~is_zero_words(keys_c)
+        match_c = key_valid_c & words_equal(
+            keys_c, ctx["ka"][:, None, None, :]
+        )  # [B,D,K]
+        found_c = jnp.any(match_c, axis=2)  # [B,D]
+        chosen = (
+            jnp.zeros((b,), I32)
+            if d == 1
+            else jnp.argmax(found_c, axis=1).astype(I32)
+        )
+        ch = chosen[:, None, None, None]
+        slot_match = jnp.take_along_axis(match_c, ch[:, :, :, 0], axis=1)[:, 0]
+        entries0 = jnp.take_along_axis(
+            entries_c, ch[..., None].astype(I32), axis=1
+        )[:, 0]  # [B,K,cap,4]
+        eff_idx = jnp.take_along_axis(idxs_mb2, chosen[:, None], axis=1)[:, 0]
+        mutating = (rm_c | refresh) & jnp.any(found_c, axis=1)
+        eff_idx = jnp.where(mutating, eff_idx, m_sentinel)
+
         # my (slot, entry) matches: entry holds my msg_id's (blk, idw)
         ent_valid = entries0[:, :, :, ENT_SEQ] != 0
         em = (
@@ -615,16 +698,22 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
         )  # [B,K,cap]
         u_clear = (em & rm_c[:, None, None]).reshape(b, k * cap)
         u_refresh = (em & refresh[:, None, None]).reshape(b, k * cap)
-        clear = _bool_matmul(gequal, u_clear).reshape(b, k, cap)
-        refr = _bool_matmul(gequal, u_refresh).reshape(b, k, cap)
 
+        # aggregate op mutations onto every row of the op's bucket
+        rows_idx = idxs_mb2.reshape(b * d)  # [B*D]
+        row_op = (rows_idx[:, None] == eff_idx[None, :]) & mutating[None, :]
+        clear = _bool_matmul(row_op, u_clear).reshape(b * d, k, cap)
+        refr = _bool_matmul(row_op, u_refresh).reshape(b * d, k, cap)
+
+        rows_entries = entries_c.reshape(b * d, k, cap, 4)
+        rows_keys = keys_c.reshape(b * d, k, 8)
         ents = jnp.where(
             refr[:, :, :, None],
-            entries0.at[:, :, :, ENT_TS].set(now),
-            entries0,
+            rows_entries.at[:, :, :, ENT_TS].set(now),
+            rows_entries,
         )
         ents = jnp.where(clear[:, :, :, None], U32(0), ents)
-        final_val = _mb_pack_batch(ecfg, keys0, ents)
+        final_val = _mb_pack_batch(ecfg, rows_keys, ents)
         final_alive = present0  # sticky slots: blocks persist until sweep
         return {}, final_val, final_alive
 

@@ -161,7 +161,7 @@ def test_pod_2e24_round_and_sweep():
 
     assert np.all(np.asarray(resp["status"]) == C.STATUS_CODE_SUCCESS)
     assert int(np.asarray(state.rec.overflow)) == 0
-    assert np.asarray(transcripts).shape == (b, 3)
+    assert np.asarray(transcripts).shape == (b, 2 * cfg.resolved_mailbox_choices + 1)
 
     swept = jax.jit(expiry_sweep, static_argnums=(0,))(
         ecfg, state, np.uint32(1_700_000_000 + 100), np.uint32(10)

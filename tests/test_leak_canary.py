@@ -173,6 +173,9 @@ def test_engine_transcript_passes_all_detectors():
             ),
         )
 
+    # transcript columns: [a_0..a_{D-1}, b, c_0..c_{D-1}] (round_step.py)
+    dcol = cfg.resolved_mailbox_choices
+    mb_cols = list(range(dcol)) + list(range(dcol + 1, 2 * dcol + 1))
     mb_pool, rec_pool = [], []
     mid = None
     rec_leaves_of_mid = []
@@ -185,9 +188,9 @@ def test_engine_transcript_passes_all_detectors():
         if mid is None and resps[0].status_code == C.STATUS_CODE_SUCCESS:
             mid = resps[0].record.msg_id
         elif mid is not None:
-            rec_leaves_of_mid.append(int(tr[1, 1]))  # records-round leaf
-        mb_pool.append(tr[:, [0, 2]].ravel())
-        rec_pool.append(tr[:, 1])
+            rec_leaves_of_mid.append(int(tr[1, dcol]))  # records-round leaf
+        mb_pool.append(tr[:, mb_cols].ravel())
+        rec_pool.append(tr[:, dcol])
 
     from grapevine_tpu.engine.state import EngineConfig
 
@@ -260,7 +263,8 @@ def test_rud_transcript_distributions_indistinguishable():
             # the rt op itself must succeed — a silently failing op
             # would make all three pools identical no-op samples
             assert resps[0].status_code == C.STATUS_CODE_SUCCESS
-            pool.append(int(np.asarray(tr)[0, 1]))  # the rt round's leaf
+            # records-round leaf: column D in [a_0..a_{D-1}, b, c_...]
+            pool.append(int(np.asarray(tr)[0, cfg.resolved_mailbox_choices]))
         return np.asarray(pool)
 
     pools = {}
