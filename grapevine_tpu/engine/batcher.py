@@ -74,7 +74,10 @@ def pack_batch(reqs: list[QueryRequest], batch_size: int, now: int) -> dict:
         "msg_id": col(ID_WORDS, (r.record.msg_id for r in reqs)),
         "recipient": col(KEY_WORDS, (r.record.recipient for r in reqs)),
         "payload": col(PAYLOAD_WORDS, (r.record.payload for r in reqs)),
-        "now": np.uint32(min(int(now), 0xFFFFFFFF)),
+        # u64 clock as two u32 lanes (wire timestamps are u64; no 2106
+        # rollover on the device path either)
+        "now": np.uint32(int(now) & 0xFFFFFFFF),
+        "now_hi": np.uint32((int(now) >> 32) & 0xFFFFFFFF),
     }
 
 
@@ -83,7 +86,8 @@ def unpack_responses(resp: dict, n: int) -> list[QueryResponse]:
     sliced out of the flat buffer (bytes slicing is C-speed; the old
     per-row ``tobytes`` loop was ~8 ms at B=2048)."""
     status = np.asarray(resp["status"])[:n].tolist()
-    ts = np.asarray(resp["timestamp"])[:n].tolist()
+    ts_lanes = np.asarray(resp["timestamp"])[:n].astype(np.uint64)
+    ts = (ts_lanes[:, 0] | (ts_lanes[:, 1] << np.uint64(32))).tolist()
 
     def rows(name: str, words: int) -> list[bytes]:
         flat = np.ascontiguousarray(
@@ -218,8 +222,9 @@ class GrapevineEngine:
             self.state = self._sweep(
                 self.ecfg,
                 self.state,
-                np.uint32(min(int(now), 0xFFFFFFFF)),
+                np.uint32(int(now) & 0xFFFFFFFF),
                 np.uint32(period),
+                np.uint32((int(now) >> 32) & 0xFFFFFFFF),
             )
             evicted = int(self.state.free_top) - before
             self.metrics.record_sweep(evicted)

@@ -24,21 +24,34 @@ import jax
 import jax.numpy as jnp
 
 from ..oblivious.bucket_cipher import epoch_next, row_keystream
-from ..oblivious.primitives import SENTINEL, is_zero_words
+from ..oblivious.primitives import SENTINEL, is_zero_words, u64_le, u64_sub
 from ..oram.path_oram import OramConfig, OramState
-from .state import ENT_SEQ, ENT_TS, EngineConfig, EngineState, REC_TS
+from .state import (
+    ENT_SEQ,
+    ENT_SEQH,
+    ENT_TS,
+    ENT_TSH,
+    ENTRY_WORDS,
+    EngineConfig,
+    EngineState,
+    KEY_WORDS,
+    REC_TS,
+    REC_TSH,
+)
 
 U32 = jnp.uint32
 
 
-def _expired(ts: jnp.ndarray, now, period) -> jnp.ndarray:
-    """Strict '>' age test, matching the oracle (now - ts > period).
+def _expired(ts_lo, ts_hi, now_lo, now_hi, period) -> jnp.ndarray:
+    """Strict '>' age test over u64 lane pairs (now - ts > period).
 
-    Guarded against u32 wraparound: a record stamped *ahead* of the sweep
+    Guarded against wraparound: a record stamped *ahead* of the sweep
     clock (NTP step-back, caller-supplied smaller ``now``) must never be
     treated as ancient — the oracle's signed comparison keeps it, so we
     must too."""
-    return (ts <= now) & ((now - ts) > period)
+    le = u64_le(ts_lo, ts_hi, now_lo, now_hi)
+    d_lo, d_hi = u64_sub(now_lo, now_hi, ts_lo, ts_hi)
+    return le & ((d_hi > 0) | (d_lo > period))
 
 
 def _chunk_rows(cfg: OramConfig) -> int:
@@ -96,8 +109,11 @@ def _chunked_tree_sweep(cfg: OramConfig, oram: OramState, carry0, body):
     return carry, new
 
 
-def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineState:
+def expiry_sweep(
+    ecfg: EngineConfig, state: EngineState, now, period, now_hi=0
+) -> EngineState:
     now = U32(now)
+    now_hi = U32(now_hi)
     period = U32(period)
 
     # --- records ORAM: invalidate expired blocks, gather liveness ------
@@ -107,9 +123,10 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
 
     def rec_body(present, xs):
         ix, vl = xs  # [rpc, Z], [rpc, Z*V] plaintext
-        ts = vl[:, REC_TS::v][:, : rcfg.bucket_slots]
+        ts_lo = vl[:, REC_TS::v][:, : rcfg.bucket_slots]
+        ts_hi = vl[:, REC_TSH::v][:, : rcfg.bucket_slots]
         live = ix != SENTINEL
-        dead = live & _expired(ts, now, period)
+        dead = live & _expired(ts_lo, ts_hi, now, now_hi, period)
         ix = jnp.where(dead, SENTINEL, ix)
         safe = jnp.where(ix != SENTINEL, ix, U32(n_msgs)).reshape(-1)
         present = present.at[safe].set(True, mode="drop")
@@ -120,7 +137,11 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
 
     # stash rows are plaintext private state
     st_live = state.rec.stash_idx != SENTINEL
-    st_dead = st_live & _expired(state.rec.stash_val[:, REC_TS], now, period)
+    st_dead = st_live & _expired(
+        state.rec.stash_val[:, REC_TS],
+        state.rec.stash_val[:, REC_TSH],
+        now, now_hi, period,
+    )
     rec_stash_idx = jnp.where(st_dead, SENTINEL, state.rec.stash_idx)
     safe = jnp.where(rec_stash_idx != SENTINEL, rec_stash_idx, U32(n_msgs))
     present = present.at[safe].set(True, mode="drop")
@@ -132,16 +153,22 @@ def expiry_sweep(ecfg: EngineConfig, state: EngineState, now, period) -> EngineS
     def sweep_mb(idx, val):
         # idx: [...]; val: blocks of V words — one block per idx entry
         lead = idx.shape
-        flat = val.reshape((-1, k * (8 + 4 * cap)))
-        keys = flat.reshape(-1, k, 8 + 4 * cap)[:, :, :8]
-        entries = flat.reshape(-1, k, 8 + 4 * cap)[:, :, 8:].reshape(-1, k, cap, 4)
-        valid = entries[..., ENT_SEQ] != 0
-        dead = valid & _expired(entries[..., ENT_TS], now, period)
-        entries = jnp.where(dead[..., None], jnp.zeros((4,), U32), entries)
-        mbox_live = jnp.any(entries[..., ENT_SEQ] != 0, axis=-1)  # [n, k]
+        ew = ENTRY_WORDS
+        mw = KEY_WORDS + ew * cap
+        flat = val.reshape((-1, k * mw))
+        keys = flat.reshape(-1, k, mw)[:, :, :KEY_WORDS]
+        entries = flat.reshape(-1, k, mw)[:, :, KEY_WORDS:].reshape(-1, k, cap, ew)
+        valid = (entries[..., ENT_SEQ] | entries[..., ENT_SEQH]) != 0
+        dead = valid & _expired(
+            entries[..., ENT_TS], entries[..., ENT_TSH], now, now_hi, period
+        )
+        entries = jnp.where(dead[..., None], jnp.zeros((ew,), U32), entries)
+        mbox_live = jnp.any(
+            (entries[..., ENT_SEQ] | entries[..., ENT_SEQH]) != 0, axis=-1
+        )  # [n, k]
         keys = jnp.where(mbox_live[..., None], keys, jnp.zeros((8,), U32))
         out = jnp.concatenate(
-            [keys, entries.reshape(-1, k, cap * 4)], axis=-1
+            [keys, entries.reshape(-1, k, cap * ew)], axis=-1
         ).reshape(flat.shape)
         # blocks with no live mailbox leave the ORAM entirely
         any_key = jnp.any(

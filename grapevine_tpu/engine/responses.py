@@ -31,10 +31,12 @@ def assemble_responses(
     auth,
     recipient,
     payload,
-    now,
+    now2,
 ):
     """Build the response pytree. All mask args are bool scalars or
-    bool[B]; multi-word fields have one trailing word axis."""
+    bool[B]; multi-word fields have one trailing word axis. ``now2`` is
+    the u64 server clock as u32[2] (lo, hi); the timestamp field is
+    likewise two lanes."""
     ok_rud = out_b["read_ok"] | out_b["upd_ok"] | out_b["del_ok"]
     status = jnp.where(
         ~is_real,
@@ -70,9 +72,9 @@ def assemble_responses(
             cr, recipient, jnp.where(okr, out_b["resp_recipient"], U32(0))
         ),
         "timestamp": jnp.where(
-            created | ok_rud,
-            jnp.where(created, now, out_b["resp_ts"]),
-            jnp.where(is_real, now, U32(0)),
+            (created | ok_rud)[..., None],
+            jnp.where(created[..., None], now2, out_b["resp_ts"]),
+            jnp.where(is_real[..., None], now2, U32(0)),
         ),
         "payload": jnp.where(
             cr, payload, jnp.where(okr, out_b["resp_payload"], U32(0))

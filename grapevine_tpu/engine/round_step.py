@@ -48,6 +48,7 @@ from ..oblivious.prp import prp2_decrypt
 from ..wire import constants as C
 from ..oram.round import oram_round
 from .responses import assemble_responses
+from ..oblivious.primitives import u64_add_u32
 from .state import EngineConfig, EngineState, mb_bucket_hash
 from .vphases import phase_a_batch, phase_b_batch, phase_c_batch
 
@@ -67,6 +68,11 @@ def engine_round_step(
     """
     b = batch["req_type"].shape[0]
     now = batch["now"].astype(U32)
+    # u64 clock: low lane in "now", optional high lane in "now_hi"
+    # (absent in pre-widening batch dicts — membership is trace-static)
+    now_hi = (
+        batch["now_hi"].astype(U32) if "now_hi" in batch else jnp.zeros((), U32)
+    )
     rt = batch["req_type"].astype(U32)
     auth = batch["auth"]
     msg_id = batch["msg_id"]
@@ -139,6 +145,7 @@ def engine_round_step(
         "recipients0": state.recipients,
         "seq0": state.seq,
         "now": now,
+        "now_hi": now_hi,
         "auth": auth,
         "recipient": recipient,
         "msg_id": msg_id,
@@ -150,7 +157,8 @@ def engine_round_step(
     )
     free_top = state.free_top - out_a["n_allocs"]
     recipients = state.recipients + out_a["n_claims"]
-    seq = state.seq + U32(b)
+    seq_lo, seq_hi = u64_add_u32(state.seq[0], state.seq[1], U32(b))
+    seq = jnp.stack([seq_lo, seq_hi])
 
     # ---- round B: records (verify, insert, mutate, remove) ------------
     # id words 0-1 are the PRP-encrypted (nonce, block index)
@@ -215,7 +223,7 @@ def engine_round_step(
         auth=auth,
         recipient=recipient,
         payload=payload,
-        now=now,
+        now2=jnp.stack([now, now_hi]).astype(U32),
     )
     # transcript: D leaves per mailbox round + 1 records leaf per op —
     # [B, 2D+1] columns (a_0..a_{D-1}, b, c_0..c_{D-1}); every entry an

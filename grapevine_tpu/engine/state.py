@@ -1,16 +1,18 @@
 """Engine state: the two ORAMs plus private scalar bookkeeping.
 
 Value layouts (all uint32 words, little-endian byte order on the host
-side; the device timestamp is a u32 of unix seconds — sufficient until
-2106, the wire format stays u64):
+side; timestamps and the insertion sequence counter are u64 carried as
+two u32 lanes (lo, hi) — matching the wire's u64 timestamp with no 2106
+rollover and no 2^32-creates lifetime bound):
 
 records ORAM block (one Record, reference README.md:132-136):
-    id[4] | sender[8] | recipient[8] | ts[1] | payload[234]   = 255 words
+    id[4] | sender[8] | recipient[8] | ts[2] | payload[234]   = 256 words
+    (exactly the reference's 1024-byte record)
 
 mailbox ORAM block (one hash bucket of K mailboxes):
-    per mailbox: key[8] | entries[cap × (blk[1] | idw[1] | seq[1] | ts[1])]
-    → K * (8 + 4*cap) words; with cap=62 a mailbox is exactly 256 words
-    (1 KiB), matching the record block budget.
+    per mailbox: key[8] |
+        entries[cap × (blk[1] | idw[1] | seq[2] | ts[2])]
+    → K * (8 + 6*cap) words.
 
 A mailbox entry stores only the record's block index plus the second
 msg-id word; the full 128-bit id lives in (and is verified against) the
@@ -38,22 +40,25 @@ from ..oram.path_oram import OramConfig, OramState, init_oram
 
 U32 = jnp.uint32
 
-# records block layout offsets (words)
+# records block layout offsets (words); u64 fields = (lo, hi) u32 lanes
 REC_ID = slice(0, 4)
 REC_SENDER = slice(4, 12)
 REC_RECIPIENT = slice(12, 20)
-REC_TS = 20
-REC_PAYLOAD = slice(21, 255)
-REC_WORDS = 255
+REC_TS = 20  # u64 low lane; high lane at REC_TSH
+REC_TSH = 21
+REC_PAYLOAD = slice(22, 256)
+REC_WORDS = 256
 
 PAYLOAD_WORDS = 234
 KEY_WORDS = 8
 ID_WORDS = 4
-ENTRY_WORDS = 4  # record block index | msg-id word 1 | seq | ts
+ENTRY_WORDS = 6  # blk | msg-id word 1 | seq lo | seq hi | ts lo | ts hi
 ENT_BLK = 0
 ENT_IDW = 1
-ENT_SEQ = 2
-ENT_TS = 3
+ENT_SEQ = 2  # u64 low lane
+ENT_SEQH = 3
+ENT_TS = 4  # u64 low lane
+ENT_TSH = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,7 +122,7 @@ class EngineState(NamedTuple):
     freelist: jax.Array  # u32[max_messages]; [0:free_top] = free block indices
     free_top: jax.Array  # u32 scalar
     recipients: jax.Array  # u32 scalar: live recipients
-    seq: jax.Array  # u32 scalar: global insertion counter
+    seq: jax.Array  # u32[2] (lo, hi): u64 global insertion counter
     hash_key: jax.Array  # u32[2]: keyed mailbox-bucket PRF
     id_key: jax.Array  # u32[4]: block-index PRP key (oblivious/prp.py)
     rng: jax.Array  # jax PRNG key
@@ -132,7 +137,7 @@ def init_engine(ecfg: EngineConfig, seed: int = 0) -> EngineState:
         freelist=jnp.arange(ecfg.max_messages, dtype=U32),
         free_top=jnp.uint32(ecfg.max_messages),
         recipients=jnp.uint32(0),
-        seq=jnp.uint32(1),
+        seq=jnp.array([1, 0], U32),
         hash_key=jax.random.bits(k_hash, (2,), U32),
         id_key=jax.random.bits(k_id, (4,), U32),
         rng=k_rng,

@@ -50,11 +50,15 @@ from ..oblivious.prp import prp2_decrypt, prp2_encrypt
 from ..wire import constants as C
 from ..oram.path_oram import oram_access
 from .responses import assemble_responses
+from ..oblivious.primitives import u64_add_u32
 from .state import (
     ENT_BLK,
     ENT_IDW,
     ENT_SEQ,
+    ENT_SEQH,
     ENT_TS,
+    ENT_TSH,
+    ENTRY_WORDS,
     EngineConfig,
     EngineState,
     REC_ID,
@@ -62,6 +66,7 @@ from .state import (
     REC_RECIPIENT,
     REC_SENDER,
     REC_TS,
+    REC_TSH,
     mb_bucket_hash,
     mb_pack,
     mb_parse,
@@ -79,8 +84,8 @@ def _phase_a(ecfg: EngineConfig, value, present, o):
     has_free_slot = jnp.any(~key_valid)
     tgt_oh = jnp.where(found, slot_match, free_slot_oh)
 
-    tgt_entries = onehot_select(tgt_oh, entries)  # [cap, 4]
-    ent_valid = tgt_entries[:, ENT_SEQ] != 0
+    tgt_entries = onehot_select(tgt_oh, entries)  # [cap, ENTRY_WORDS]
+    ent_valid = (tgt_entries[:, ENT_SEQ] | tgt_entries[:, ENT_SEQH]) != 0
     count = jnp.sum(ent_valid.astype(jnp.int32))
 
     # --- CREATE decision tree (status precedence documented in
@@ -114,7 +119,7 @@ def _phase_a(ecfg: EngineConfig, value, present, o):
 
     # --- zero-id selection: oldest entry (min seq) ---------------------
     sel_oh, sel_found = argmin_u64_onehot(
-        ent_valid, jnp.zeros_like(tgt_entries[:, ENT_SEQ]), tgt_entries[:, ENT_SEQ]
+        ent_valid, tgt_entries[:, ENT_SEQH], tgt_entries[:, ENT_SEQ]
     )
     sel_entry = onehot_select(sel_oh, tgt_entries)
     sel_found = sel_found & found
@@ -131,9 +136,20 @@ def _phase_a(ecfg: EngineConfig, value, present, o):
 
     # --- apply append / removal to the target mailbox ------------------
     append_oh = first_true_onehot(~ent_valid) & create_ok
-    new_entry = jnp.stack([o["new_id"][0], o["new_id"][1], o["seq"], o["now"]])
+    new_entry = jnp.stack(
+        [
+            o["new_id"][0],
+            o["new_id"][1],
+            o["seq"][0],
+            o["seq"][1],
+            o["now"],
+            o["now_hi"],
+        ]
+    )
     ent_mod = jnp.where(append_oh[:, None], new_entry[None, :], tgt_entries)
-    ent_mod = jnp.where((rm_oh & rm_a)[:, None], jnp.zeros((4,), U32)[None, :], ent_mod)
+    ent_mod = jnp.where(
+        (rm_oh & rm_a)[:, None], jnp.zeros((ENTRY_WORDS,), U32)[None, :], ent_mod
+    )
 
     # sticky mailbox slots: a drained mailbox keeps its key slot until
     # the expiry sweep reclaims it (see engine/vphases.py docstring)
@@ -165,7 +181,7 @@ def _phase_b(ecfg: EngineConfig, value, present, o):
     stored_id = value[REC_ID]
     sender = value[REC_SENDER]
     recip_st = value[REC_RECIPIENT]
-    ts = value[REC_TS]
+    ts2 = value[REC_TS : REC_TSH + 1]  # u32[2] (lo, hi)
 
     match2 = (stored_id[0] == o["sel_blk"]) & (stored_id[1] == o["sel_idw"])
     match4 = words_equal(stored_id, o["msg_id"])
@@ -178,16 +194,21 @@ def _phase_b(ecfg: EngineConfig, value, present, o):
     upd_ok = o["is_update"] & match_ok & auth_ok & recip_match
     del_ok = o["is_delete"] & match_ok & auth_ok & (o["id_zero"] | recip_match)
 
+    now2 = jnp.stack([o["now"], o["now_hi"]]).astype(U32)
     new_rec = jnp.concatenate(
         [
             o["new_id"],
             o["auth"],
             o["recipient"],
-            o["now"][None],
+            now2,
             o["payload"],
         ]
     )
-    updated = value.at[REC_TS].set(o["now"]).at[REC_PAYLOAD].set(o["payload"])
+    updated = (
+        value.at[REC_TS].set(o["now"])
+        .at[REC_TSH].set(o["now_hi"])
+        .at[REC_PAYLOAD].set(o["payload"])
+    )
     new_value = jnp.where(
         o["create_ok"], new_rec, jnp.where(upd_ok, updated, value)
     )
@@ -204,7 +225,7 @@ def _phase_b(ecfg: EngineConfig, value, present, o):
         "resp_id": stored_id,
         "resp_sender": sender,
         "resp_recipient": recip_st,
-        "resp_ts": jnp.where(upd_ok, o["now"], ts),
+        "resp_ts": jnp.where(upd_ok, now2, ts2),
         "resp_payload": jnp.where(upd_ok, o["payload"], value[REC_PAYLOAD]),
     }
     return new_value, keep, insert, out
@@ -216,7 +237,7 @@ def _phase_c(ecfg: EngineConfig, value, present, o):
     slot_match = key_valid & words_equal(keys, o["ka"][None, :])
     found = jnp.any(slot_match)
     tgt_entries = onehot_select(slot_match, entries)
-    ent_valid = tgt_entries[:, ENT_SEQ] != 0
+    ent_valid = (tgt_entries[:, ENT_SEQ] | tgt_entries[:, ENT_SEQH]) != 0
 
     ent_match = (
         ent_valid
@@ -227,13 +248,15 @@ def _phase_c(ecfg: EngineConfig, value, present, o):
     # sender-authorized delete finalization (B proved del_ok; A did not act)
     rm_c = o["del_ok"] & ~o["rm_a"] & found
     ent_mod = jnp.where(
-        (ent_match & rm_c)[:, None], jnp.zeros((4,), U32)[None, :], tgt_entries
+        (ent_match & rm_c)[:, None],
+        jnp.zeros((ENTRY_WORDS,), U32)[None, :],
+        tgt_entries,
     )
     # update refreshes the entry's expiry timestamp (record ts moved in B)
     refresh = o["upd_ok"] & found
     ent_mod = jnp.where(
         (ent_match & refresh)[:, None],
-        ent_mod.at[:, ENT_TS].set(o["now"]),
+        ent_mod.at[:, ENT_TS].set(o["now"]).at[:, ENT_TSH].set(o["now_hi"]),
         ent_mod,
     )
 
@@ -268,6 +291,9 @@ def engine_step(
     """
     B = batch["req_type"].shape[0]
     now = batch["now"].astype(U32)
+    now_hi = (
+        batch["now_hi"].astype(U32) if "now_hi" in batch else jnp.zeros((), U32)
+    )
 
     k_a, k_b, k_c, k_id, k_next = jax.random.split(state.rng, 5)
     leaves_a = jax.random.bits(k_a, (B,), U32) & U32(ecfg.mb.leaves - 1)
@@ -306,6 +332,7 @@ def engine_step(
             "recipient": recipient,
             "payload": payload,
             "now": now,
+            "now_hi": now_hi,
             "seq": carry.seq,
             "recipients": carry.recipients,
             "alloc_idx": alloc_idx,
@@ -379,7 +406,10 @@ def engine_step(
             + out_a["recip_delta"]
             + out_c["recip_delta"]
         ).astype(U32)
-        seq = carry.seq + out_a["create_ok"].astype(U32)
+        sq_lo, sq_hi = u64_add_u32(
+            carry.seq[0], carry.seq[1], out_a["create_ok"].astype(U32)
+        )
+        seq = jnp.stack([sq_lo, sq_hi])
 
         # -- response assembly (shared with the phase-major engine) -----
         resp = assemble_responses(
@@ -395,7 +425,7 @@ def engine_step(
             auth=auth,
             recipient=recipient,
             payload=payload,
-            now=now,
+            now2=jnp.stack([now, now_hi]).astype(U32),
         )
         transcript = jnp.stack([leaf_a, leaf_b, leaf_c])
 

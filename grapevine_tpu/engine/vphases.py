@@ -38,8 +38,8 @@ Semantics notes vs the original chain engine (mirrored by the oracle):
   reference never specifies reclamation timing.)
 - **Seq numbering by slot**: a created entry's order stamp is
   ``seq0 + slot`` and ``seq`` advances by B per round, preserving
-  slot-order semantics with gaps. Wraparound bound (2^32 creates per bus
-  lifetime) documented in wire/constants.py.
+  slot-order semantics with gaps. The counter is u64 (two u32 lanes) —
+  no realistic wraparound.
 """
 
 from __future__ import annotations
@@ -47,7 +47,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..oblivious.primitives import is_zero_words, rank_of, words_equal
+from ..oblivious.primitives import (
+    is_zero_words,
+    lex_argsort,
+    rank_of,
+    u64_add_u32,
+    words_equal,
+)
 from ..oblivious.prp import prp2_encrypt
 from ..oblivious.segmented import (
     group_sort,
@@ -59,13 +65,17 @@ from .state import (
     ENT_BLK,
     ENT_IDW,
     ENT_SEQ,
+    ENT_SEQH,
     ENT_TS,
+    ENT_TSH,
+    ENTRY_WORDS,
     EngineConfig,
     REC_ID,
     REC_PAYLOAD,
     REC_RECIPIENT,
     REC_SENDER,
     REC_TS,
+    REC_TSH,
 )
 
 U32 = jnp.uint32
@@ -101,18 +111,18 @@ def _bool_matmul(m: jax.Array, u: jax.Array) -> jax.Array:
 
 
 def _mb_parse_batch(ecfg: EngineConfig, vals: jax.Array):
-    """[B, Vmb] → keys [B,K,8], entries [B,K,cap,4]."""
+    """[B, Vmb] → keys [B,K,8], entries [B,K,cap,ENTRY_WORDS]."""
     b = vals.shape[0]
-    k, cap = ecfg.mb_slots, ecfg.mailbox_cap
-    v = vals.reshape(b, k, 8 + 4 * cap)
-    return v[:, :, :8], v[:, :, 8:].reshape(b, k, cap, 4)
+    k, cap, ew = ecfg.mb_slots, ecfg.mailbox_cap, ENTRY_WORDS
+    v = vals.reshape(b, k, 8 + ew * cap)
+    return v[:, :, :8], v[:, :, 8:].reshape(b, k, cap, ew)
 
 
 def _mb_pack_batch(ecfg: EngineConfig, keys: jax.Array, entries: jax.Array):
     b = keys.shape[0]
-    k, cap = ecfg.mb_slots, ecfg.mailbox_cap
-    flat = jnp.concatenate([keys, entries.reshape(b, k, cap * 4)], axis=2)
-    return flat.reshape(b, k * (8 + 4 * cap))
+    k, cap, ew = ecfg.mb_slots, ecfg.mailbox_cap, ENTRY_WORDS
+    flat = jnp.concatenate([keys, entries.reshape(b, k, cap * ew)], axis=2)
+    return flat.reshape(b, k * (8 + ew * cap))
 
 
 # ----------------------------------------------------------------------
@@ -292,7 +302,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # --- candidate choice: [B*D] rows → per-op chosen views -------
         keys_c, entries_c = _mb_parse_batch(ecfg, vals0)  # [B*D,K,..]
         keys_c = keys_c.reshape(b, d, k, 8)
-        entries_c = entries_c.reshape(b, d, k, cap, 4)
+        entries_c = entries_c.reshape(b, d, k, cap, ENTRY_WORDS)
         key_valid_c = ~is_zero_words(keys_c)  # [B,D,K]
         match_c = key_valid_c & words_equal(keys_c, ka[:, None, None, :])
         found_c = jnp.any(match_c, axis=2)  # [B,D]
@@ -331,8 +341,8 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # my recipient's entries (zeros when mailbox absent)
         ent_r = jnp.sum(
             entries0 * slot_match0[:, :, None, None].astype(U32), axis=1
-        )  # [B,cap,4]
-        ent_valid = ent_r[:, :, ENT_SEQ] != 0
+        )  # [B,cap,ENTRY_WORDS]
+        ent_valid = (ent_r[:, :, ENT_SEQ] | ent_r[:, :, ENT_SEQH]) != 0
         init_count = jnp.sum(ent_valid, axis=1).astype(I32)
 
         first_create = is_create_cand & ~_any_before(requal, is_create_cand)
@@ -386,8 +396,10 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # --- zero-id selection: p-th oldest of [initial sorted ++ creates]
         pops_before = _counts_before(requal, pop_ok)
         crank = _counts_before(requal, create_ok)
-        skey = jnp.where(ent_valid, ent_r[:, :, ENT_SEQ], U32(0xFFFFFFFF))
-        order = jnp.argsort(skey, axis=1)
+        inf = U32(0xFFFFFFFF)
+        sk_lo = jnp.where(ent_valid, ent_r[:, :, ENT_SEQ], inf)
+        sk_hi = jnp.where(ent_valid, ent_r[:, :, ENT_SEQH], inf)
+        order = lex_argsort(sk_lo, sk_hi, axis=1)
         sorted_ent = jnp.take_along_axis(ent_r, order[:, :, None], axis=1)
         p = pops_before
         sel_from_init = p < init_count
@@ -457,14 +469,14 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             pop_sl.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         ).astype(I32)
-        icount_sl = jnp.sum(entries0[:, :, :, ENT_SEQ] != 0, axis=2).astype(I32)
+        valid_all = (
+            entries0[:, :, :, ENT_SEQ] | entries0[:, :, :, ENT_SEQH]
+        ) != 0
+        icount_sl = jnp.sum(valid_all, axis=2).astype(I32)
         popped_init_sl = jnp.minimum(T, icount_sl)  # [B,K]
-        skey_all = jnp.where(
-            entries0[:, :, :, ENT_SEQ] != 0,
-            entries0[:, :, :, ENT_SEQ],
-            U32(0xFFFFFFFF),
-        )
-        order_all = jnp.argsort(skey_all, axis=2)
+        sk_lo_all = jnp.where(valid_all, entries0[:, :, :, ENT_SEQ], inf)
+        sk_hi_all = jnp.where(valid_all, entries0[:, :, :, ENT_SEQH], inf)
+        order_all = lex_argsort(sk_lo_all, sk_hi_all, axis=2)
         sorted_all = jnp.take_along_axis(entries0, order_all[:, :, :, None], axis=2)
         e_iota = jnp.arange(cap, dtype=I32)[None, None, :]
         src = e_iota + popped_init_sl[:, :, None]  # [B,K,cap]
@@ -488,8 +500,16 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             jnp.where(surv, mslot_idx, U32(k)),
             jnp.where(surv, pos.astype(U32), U32(cap)),
         )
+        sq_lo, sq_hi = u64_add_u32(ctx["seq0"][0], ctx["seq0"][1], iota)
         new_entry = jnp.stack(
-            [new_id[:, 0], new_id[:, 1], ctx["seq0"] + iota, jnp.full((b,), now, U32)],
+            [
+                new_id[:, 0],
+                new_id[:, 1],
+                sq_lo,
+                sq_hi,
+                jnp.full((b,), now, U32),
+                jnp.full((b,), ctx["now_hi"], U32),
+            ],
             axis=1,
         )
         ents_fin = ents_fin.at[etgt].set(new_entry, mode="drop")
@@ -560,7 +580,7 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         init_id = vals0[:, REC_ID]
         init_sender = vals0[:, REC_SENDER]
         init_recip = vals0[:, REC_RECIPIENT]
-        init_ts = vals0[:, REC_TS]
+        init_ts = vals0[:, REC_TS : REC_TSH + 1]  # u32[B,2] (lo, hi)
         init_payload = vals0[:, REC_PAYLOAD]
 
         # identity fields are fixed per key: creation (in-round) or initial
@@ -604,7 +624,8 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         resp_payload = jnp.where(
             has_w[:, None], ctx["payload"][lwc], init_payload
         )
-        resp_ts = jnp.where(has_w, now, init_ts)
+        now2 = jnp.stack([now, ctx["now_hi"]]).astype(U32)
+        resp_ts = jnp.where(has_w[:, None], now2[None, :], init_ts)
 
         out_b = {
             "read_ok": read_ok,
@@ -631,9 +652,9 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         fin_payload = jnp.where(
             has_wf[:, None], ctx["payload"][lwfc], init_payload
         )
-        fin_ts = jnp.where(has_wf, now, init_ts)
+        fin_ts = jnp.where(has_wf[:, None], now2[None, :], init_ts)
         final_val = jnp.concatenate(
-            [sid, ssender, srecip, fin_ts[:, None], fin_payload], axis=1
+            [sid, ssender, srecip, fin_ts, fin_payload], axis=1
         )
         return out_b, final_val, final_alive
 
@@ -668,7 +689,7 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
     def apply_batch(vals0, present0):
         keys_c, entries_c = _mb_parse_batch(ecfg, vals0)
         keys_c = keys_c.reshape(b, d, k, 8)
-        entries_c = entries_c.reshape(b, d, k, cap, 4)
+        entries_c = entries_c.reshape(b, d, k, cap, ENTRY_WORDS)
         key_valid_c = ~is_zero_words(keys_c)
         match_c = key_valid_c & words_equal(
             keys_c, ctx["ka"][:, None, None, :]
@@ -689,7 +710,9 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
         eff_idx = jnp.where(mutating, eff_idx, m_sentinel)
 
         # my (slot, entry) matches: entry holds my msg_id's (blk, idw)
-        ent_valid = entries0[:, :, :, ENT_SEQ] != 0
+        ent_valid = (
+            entries0[:, :, :, ENT_SEQ] | entries0[:, :, :, ENT_SEQH]
+        ) != 0
         em = (
             ent_valid
             & (entries0[:, :, :, ENT_BLK] == ctx["msg_id"][:, 0, None, None])
@@ -705,11 +728,14 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
         clear = _bool_matmul(row_op, u_clear).reshape(b * d, k, cap)
         refr = _bool_matmul(row_op, u_refresh).reshape(b * d, k, cap)
 
-        rows_entries = entries_c.reshape(b * d, k, cap, 4)
+        rows_entries = entries_c.reshape(b * d, k, cap, ENTRY_WORDS)
         rows_keys = keys_c.reshape(b * d, k, 8)
         ents = jnp.where(
             refr[:, :, :, None],
-            rows_entries.at[:, :, :, ENT_TS].set(now),
+            rows_entries.at[:, :, :, ENT_TS]
+            .set(now)
+            .at[:, :, :, ENT_TSH]
+            .set(ctx["now_hi"]),
             rows_entries,
         )
         ents = jnp.where(clear[:, :, :, None], U32(0), ents)

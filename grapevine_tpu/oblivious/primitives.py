@@ -81,3 +81,31 @@ def argmin_u64_onehot(valid, hi, lo):
 def rank_of(mask):
     """Exclusive prefix count of True lanes: rank[i] = #True among mask[:i]."""
     return jnp.cumsum(mask.astype(jnp.int32)) - mask.astype(jnp.int32)
+
+def u64_add_u32(lo, hi, k):
+    """(lo, hi) + k with carry — u64 arithmetic in u32 lanes (x64 off)."""
+    s = lo + k
+    return s, hi + (s < lo).astype(lo.dtype)
+
+
+def u64_le(a_lo, a_hi, b_lo, b_hi):
+    """a <= b over (lo, hi) u32 lane pairs."""
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+
+def u64_sub(a_lo, a_hi, b_lo, b_hi):
+    """a - b (mod 2^64) over u32 lane pairs."""
+    lo = a_lo - b_lo
+    return lo, a_hi - b_hi - (a_lo < b_lo).astype(a_lo.dtype)
+
+
+def lex_argsort(lo, hi, axis=-1):
+    """Ascending argsort by the 64-bit key (hi, lo), u32 lanes.
+
+    Two stable passes: sort by the low lanes, then by the high lanes —
+    lexicographic order without u64 dtypes (jax runs with x64 off).
+    """
+    p1 = jnp.argsort(lo, axis=axis, stable=True)
+    hi_p = jnp.take_along_axis(hi, p1, axis=axis)
+    p2 = jnp.argsort(hi_p, axis=axis, stable=True)
+    return jnp.take_along_axis(p1, p2, axis=axis)

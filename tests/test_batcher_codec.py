@@ -54,14 +54,18 @@ def test_unpack_slices_rows_correctly():
         "msg_id": np.arange(b * ID_WORDS, dtype=np.uint32).reshape(b, ID_WORDS),
         "sender": np.arange(b * KEY_WORDS, dtype=np.uint32).reshape(b, KEY_WORDS),
         "recipient": np.arange(b * KEY_WORDS, dtype=np.uint32).reshape(b, KEY_WORDS) + 7,
-        "timestamp": np.arange(b, dtype=np.uint32) + 100,
+        # u64 lanes: (lo, hi); hi exercises the 2106+ range
+        "timestamp": np.stack(
+            [np.arange(b, dtype=np.uint32) + 100,
+             np.full(b, 2, dtype=np.uint32)], axis=1
+        ),
         "payload": np.arange(b * PAYLOAD_WORDS, dtype=np.uint32).reshape(b, PAYLOAD_WORDS),
     }
     out = unpack_responses(resp, 4)  # fewer than the device batch
     assert len(out) == 4
     for i, q in enumerate(out):
         assert q.status_code == i + 1
-        assert q.record.timestamp == 100 + i
+        assert q.record.timestamp == (2 << 32) + 100 + i
         assert q.record.msg_id == resp["msg_id"][i].astype("<u4").tobytes()
         assert q.record.sender == resp["sender"][i].astype("<u4").tobytes()
         assert q.record.recipient == resp["recipient"][i].astype("<u4").tobytes()
