@@ -257,18 +257,27 @@ def bench_zipf_mixed(smoke, cipher_impl="jnp"):
             "batch": batch, "capacity_log2": cap.bit_length() - 1}
 
 
-def bench_zipf_pallas(smoke):
-    """zipf_mixed through the fused Pallas cipher kernel. Full-size runs
-    require a backend that compiles Mosaic (named "tpu"); elsewhere the
-    kernel would fall back to interpret mode, which at B=2048 means
-    thousands of per-tile dispatches — skipped rather than timed.
-    Smoke mode runs interpret at toy shapes to keep the path exercised."""
+def bench_zipf_pallas(smoke, impl="pallas"):
+    """zipf_mixed through a Pallas cipher kernel (``impl="pallas"`` =
+    fused VMEM keystream+XOR; ``"pallas_fused"`` = that plus the path
+    gather fused into the decrypt, one HBM pass per fetched row).
+    Full-size runs require a backend that compiles Mosaic (named
+    "tpu"); elsewhere the kernel would fall back to interpret mode,
+    which at B=2048 means thousands of per-tile dispatches — skipped
+    rather than timed. Smoke mode runs interpret at toy shapes to keep
+    the path exercised."""
     import jax
 
     backend = jax.default_backend()
     if not smoke and backend != "tpu":
         return {"skipped": f"needs a direct TPU backend for Mosaic (have {backend!r})"}
-    return bench_zipf_mixed(smoke, cipher_impl="pallas")
+    if impl == "pallas_fused" and backend != "tpu":
+        # the fused gather's grid is one step per fetched row; interpret
+        # mode executes those steps in Python — minutes even at toy
+        # shapes, so the smoke-tier correctness coverage lives in
+        # tests/test_pallas_gather.py instead
+        return {"skipped": "fused-gather interpret mode is per-row; Mosaic only"}
+    return bench_zipf_mixed(smoke, cipher_impl=impl)
 
 
 def bench_expiry_sweep(smoke):
@@ -474,6 +483,7 @@ CONFIGS = [
     ("zipf_mixed", bench_zipf_mixed),
     ("batched_read", bench_batched_read),
     ("zipf_pallas_cipher", bench_zipf_pallas),
+    ("zipf_pallas_fused", lambda smoke: bench_zipf_pallas(smoke, "pallas_fused")),
     ("crd_loop", bench_crd_loop),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),

@@ -8,8 +8,8 @@ size, batch size) are the TPU analogs of "how much EPC the enclave maps".
 
 Capacity story: the records store is a Path-ORAM bucket tree with
 ``2**records_height`` leaves and a dense block space of the same size; the
-mailbox store is a single-choice keyed-hash table (K mailboxes per bucket)
-over its own Path-ORAM, run at low load so bucket overflow is negligible.
+mailbox store is a keyed two-choice hash table (K mailboxes per bucket)
+over its own Path-ORAM, run at a load where bucket overflow is negligible.
 Maximum in-flight messages = ``max_messages`` (bounded by the free-block
 list); maximum distinct recipients with mail = ``max_recipients`` (also
 soft-bounded by table load; overflow reports TOO_MANY_RECIPIENTS).
@@ -56,10 +56,13 @@ class GrapevineConfig:
     #: analog (oblivious/bucket_cipher.py). 8 = ChaCha8 (default),
     #: 20 = RFC ChaCha20, 0 = plaintext trees.
     bucket_cipher_rounds: int = 8
-    #: cipher implementation: "jnp" (XLA, keystream materialized in HBM)
-    #: or "pallas" (fused VMEM keystream+XOR kernel,
-    #: oblivious/pallas_cipher.py; interpret mode off-TPU). Bit-identical
-    #: ciphertext either way.
+    #: cipher implementation: "jnp" (XLA, keystream materialized in
+    #: HBM), "pallas" (fused VMEM keystream+XOR kernel,
+    #: oblivious/pallas_cipher.py), or "pallas_fused" ("pallas" plus the
+    #: path fetch fused into the decrypt — one HBM pass per fetched row,
+    #: oblivious/pallas_gather.py; single-chip fetches only, the sharded
+    #: path keeps decrypt-after-psum so plaintext never transits ICI).
+    #: Interpret mode off-TPU; bit-identical ciphertext in all three.
     bucket_cipher_impl: str = "jnp"
     #: per-request signature scheme: "schnorrkel" (sr25519, byte-compatible
     #: with the reference's sign_schnorrkel clients — README.md:193-199,
@@ -82,10 +85,10 @@ class GrapevineConfig:
             raise ValueError(
                 f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
             )
-        if self.bucket_cipher_impl not in ("jnp", "pallas"):
+        if self.bucket_cipher_impl not in ("jnp", "pallas", "pallas_fused"):
             raise ValueError(
-                f"bucket_cipher_impl must be 'jnp' or 'pallas', got "
-                f"{self.bucket_cipher_impl!r}"
+                f"bucket_cipher_impl must be 'jnp', 'pallas' or "
+                f"'pallas_fused', got {self.bucket_cipher_impl!r}"
             )
         if self.signature_scheme not in ("schnorrkel", "rfc9496"):
             raise ValueError(

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import GrapevineConfig
+from ..wire import constants as C
 from ..oram.path_oram import OramConfig, OramState, init_oram
 
 U32 = jnp.uint32
@@ -46,10 +47,9 @@ REC_SENDER = slice(4, 12)
 REC_RECIPIENT = slice(12, 20)
 REC_TS = 20  # u64 low lane; high lane at REC_TSH
 REC_TSH = 21
-REC_PAYLOAD = slice(22, 256)
-REC_WORDS = 256
-
-PAYLOAD_WORDS = 234
+PAYLOAD_WORDS = C.PAYLOAD_SIZE // 4  # 234 @1KB records, 490 @2KB
+REC_PAYLOAD = slice(22, 22 + PAYLOAD_WORDS)
+REC_WORDS = 22 + PAYLOAD_WORDS  # 256 @1KB (exactly the 1024B record)
 KEY_WORDS = 8
 ID_WORDS = 4
 ENTRY_WORDS = 6  # blk | msg-id word 1 | seq lo | seq hi | ts lo | ts hi

@@ -70,6 +70,7 @@ from .state import (
     ENT_TSH,
     ENTRY_WORDS,
     EngineConfig,
+    KEY_WORDS,
     REC_ID,
     REC_PAYLOAD,
     REC_RECIPIENT,
@@ -114,15 +115,16 @@ def _mb_parse_batch(ecfg: EngineConfig, vals: jax.Array):
     """[B, Vmb] → keys [B,K,8], entries [B,K,cap,ENTRY_WORDS]."""
     b = vals.shape[0]
     k, cap, ew = ecfg.mb_slots, ecfg.mailbox_cap, ENTRY_WORDS
-    v = vals.reshape(b, k, 8 + ew * cap)
-    return v[:, :, :8], v[:, :, 8:].reshape(b, k, cap, ew)
+    kw = KEY_WORDS
+    v = vals.reshape(b, k, kw + ew * cap)
+    return v[:, :, :kw], v[:, :, kw:].reshape(b, k, cap, ew)
 
 
 def _mb_pack_batch(ecfg: EngineConfig, keys: jax.Array, entries: jax.Array):
     b = keys.shape[0]
     k, cap, ew = ecfg.mb_slots, ecfg.mailbox_cap, ENTRY_WORDS
     flat = jnp.concatenate([keys, entries.reshape(b, k, cap * ew)], axis=2)
-    return flat.reshape(b, k * (8 + ew * cap))
+    return flat.reshape(b, k * (KEY_WORDS + ew * cap))
 
 
 # ----------------------------------------------------------------------
@@ -406,7 +408,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         pi = jnp.clip(p, 0, cap - 1)
         init_sel = jnp.take_along_axis(sorted_ent, pi[:, None, None], axis=1)[
             :, 0, :
-        ]  # [B,4]
+        ]  # [B, ENTRY_WORDS]
         q = p - init_count
         sel_created_oh = (
             requal & create_ok[None, :] & (crank[None, :] == q[:, None])

@@ -1,0 +1,136 @@
+"""Pallas TPU kernel: fused path-row gather + ChaCha decrypt.
+
+PERF.md "next levers" 2: the unfused round does
+
+    gather rows (HBM read + HBM write of the gathered copy)
+    → keystream XOR (read + write again, or the fused cipher kernel)
+
+i.e. the gathered working set crosses HBM at least twice before the
+engine sees plaintext. This kernel performs the gather *and* the
+decrypt in one pass: each grid step DMAs one tree row into VMEM (the
+row index comes from the scalar-prefetched path-bucket vector, the
+standard Pallas TPU dynamic-gather pattern), generates that row's
+keystream in VMEM, and writes the decrypted row to the output — the
+row's ciphertext never lands in HBM a second time and no keystream is
+ever materialized.
+
+Scope: the single-chip fetch path (``axis_name is None``). The sharded
+path keeps gather → psum → decrypt: buckets are decrypted only *after*
+ICI assembly, so tree plaintext never transits the interconnect —
+fusing there would trade that property for bandwidth.
+
+Like the fused cipher kernel (pallas_cipher.py) this reuses
+bucket_cipher's ChaCha core verbatim and is asserted bit-identical to
+the jnp path (tests/test_pallas_gather.py); off-TPU it runs in Pallas
+interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .bucket_cipher import _SIGMA, _qr
+
+U32 = jnp.uint32
+
+
+def _gather_kernel(
+    bucket_ref,  # scalar-prefetch: u32[R] row indices (the public path)
+    key_ref,  # u32[1, 8]
+    idx_row_ref,  # u32[1, z]      tree_idx row bucket_ref[i]
+    val_row_ref,  # u32[1, z*v]    tree_val row bucket_ref[i]
+    nonce_row_ref,  # u32[1, 2]    epoch nonce of that row
+    oidx_ref,  # u32[1, z]
+    oval_ref,  # u32[1, z*v]
+    *,
+    nb,
+    z,
+    n_words,
+    rounds,
+):
+    i = pl.program_id(0)
+    bid = bucket_ref[i]
+    ctr = jax.lax.broadcasted_iota(U32, (1, nb), 1)
+    n1 = jnp.full((1, nb), bid, U32)
+    n2 = jnp.broadcast_to(nonce_row_ref[0, 0], (1, nb))
+    n3 = jnp.broadcast_to(nonce_row_ref[0, 1], (1, nb))
+    init = [jnp.full((1, nb), U32(c)) for c in _SIGMA]
+    init += [jnp.broadcast_to(key_ref[0, j], (1, nb)) for j in range(8)]
+    init += [ctr, n1, n2, n3]
+    s = list(init)
+    for _ in range(rounds // 2):
+        _qr(s, 0, 4, 8, 12)
+        _qr(s, 1, 5, 9, 13)
+        _qr(s, 2, 6, 10, 14)
+        _qr(s, 3, 7, 11, 15)
+        _qr(s, 0, 5, 10, 15)
+        _qr(s, 1, 6, 11, 12)
+        _qr(s, 2, 7, 8, 13)
+        _qr(s, 3, 4, 9, 14)
+    ks = jnp.concatenate([a + b for a, b in zip(s, init)], axis=1)
+    written = (nonce_row_ref[0, 0] != U32(0)) | (nonce_row_ref[0, 1] != U32(0))
+    oidx_ref[0, :] = idx_row_ref[0, :] ^ jnp.where(written, ks[0, :z], U32(0))
+    oval_ref[0, :] = val_row_ref[0, :] ^ jnp.where(
+        written, ks[0, z:n_words], U32(0)
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("z", "rounds", "interpret")
+)
+def gather_decrypt_rows(
+    key: jax.Array,  # u32[8]
+    tree_idx: jax.Array,  # u32[n_padded * z] (flat slot words)
+    tree_val: jax.Array,  # u32[n_padded, z*v]
+    nonces: jax.Array,  # u32[n_padded, 2]
+    flat_b: jax.Array,  # u32[R] heap-bucket indices (public transcript)
+    z: int,
+    rounds: int = 8,
+    interpret: bool = False,
+):
+    """(pidx u32[R, z], pval u32[R, z*v]) — gathered AND decrypted.
+
+    ``rounds=0`` (plaintext trees) still uses the fused gather so the
+    single-chip fetch is one HBM pass either way.
+    """
+    n_padded = tree_val.shape[0]
+    zv = tree_val.shape[1]
+    r = flat_b.shape[0]
+    w = z + zv
+    nb = (w + 15) // 16
+    idx_rows = tree_idx.reshape(n_padded, z)
+    if rounds == 0:
+        # no cipher: plain dynamic-slice gather (XLA emits one pass)
+        return idx_rows[flat_b], tree_val[flat_b]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda i, b_ref: (0, 0)),
+            pl.BlockSpec((1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
+            pl.BlockSpec((1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
+            pl.BlockSpec((1, 2), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, z), lambda i, b_ref: (i, 0)),
+            pl.BlockSpec((1, zv), lambda i, b_ref: (i, 0)),
+        ],
+    )
+    oidx, oval = pl.pallas_call(
+        functools.partial(
+            _gather_kernel, nb=nb, z=z, n_words=w, rounds=rounds
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((r, z), U32),
+            jax.ShapeDtypeStruct((r, zv), U32),
+        ],
+        interpret=interpret,
+    )(flat_b, key[None, :], idx_rows, tree_val, nonces)
+    return oidx, oval

@@ -82,7 +82,7 @@ def cipher_rows(
     if not cfg.encrypted:
         return pidx, pval
     z = cfg.bucket_slots
-    if cfg.cipher_impl == "pallas":
+    if cfg.cipher_impl in ("pallas", "pallas_fused"):
         from ..oblivious.pallas_cipher import cipher_rows_pallas
 
         interpret = jax.default_backend() != "tpu"

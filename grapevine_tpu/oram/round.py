@@ -153,10 +153,26 @@ def oram_round(
     fowner = bmap[flat_b] == cols_flat
 
     slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
-    pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(b * plen, z)
-    pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
-    pnonce = _path_gather(state.nonces, flat_b, axis_name)
-    pidx, pval = cipher_rows(cfg, state.cipher_key, flat_b, pnonce, pidx, pval)
+    if axis_name is None and cfg.cipher_impl == "pallas_fused" and cfg.encrypted:
+        # single-chip fast path: gather + decrypt in ONE HBM pass
+        # (oblivious/pallas_gather.py); the sharded path below keeps
+        # decrypt-after-psum so tree plaintext never transits ICI
+        from ..oblivious.pallas_gather import gather_decrypt_rows
+
+        pidx, pval = gather_decrypt_rows(
+            state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
+            flat_b, z=z, rounds=cfg.cipher_rounds,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(
+            b * plen, z
+        )
+        pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
+        pnonce = _path_gather(state.nonces, flat_b, axis_name)
+        pidx, pval = cipher_rows(
+            cfg, state.cipher_key, flat_b, pnonce, pidx, pval
+        )
     # non-owner copies of shared buckets are invalidated
     pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
 

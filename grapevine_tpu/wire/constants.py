@@ -13,12 +13,24 @@ These pin the byte-level contract of the reference implementation:
 """
 
 # --- Record geometry (bytes) ---------------------------------------------
+import os as _os
+
 MSG_ID_SIZE = 16
 PUBKEY_SIZE = 32  # compressed ristretto point
 TIMESTAMP_SIZE = 8  # u64 LE seconds since unix epoch
-PAYLOAD_SIZE = 936
-RECORD_SIZE = MSG_ID_SIZE + 2 * PUBKEY_SIZE + TIMESTAMP_SIZE + PAYLOAD_SIZE
-assert RECORD_SIZE == 1024
+#: the reference's compile-time record-size option: 1024 (default) or
+#: 2048 bytes (reference README.md:138-139 — "a compile time option to
+#: configure this to 2048"). Same mechanism here: a process-wide
+#: constant fixed before import (env GRAPEVINE_RECORD_SIZE); every
+#: layout below derives from it, and mixed-size processes are
+#: impossible by construction, exactly like the reference's rebuild.
+RECORD_SIZE = int(_os.environ.get("GRAPEVINE_RECORD_SIZE", "1024"))
+if RECORD_SIZE not in (1024, 2048):
+    raise ValueError(
+        f"GRAPEVINE_RECORD_SIZE must be 1024 or 2048, got {RECORD_SIZE}"
+    )
+PAYLOAD_SIZE = RECORD_SIZE - (MSG_ID_SIZE + 2 * PUBKEY_SIZE + TIMESTAMP_SIZE)
+assert PAYLOAD_SIZE in (936, 1960)
 
 SIGNATURE_SIZE = 64  # ristretto Schnorr signature (reference types/src/lib.rs:44-52)
 CHALLENGE_SIZE = 32  # bytes drawn from the challenge RNG per request
@@ -51,9 +63,9 @@ MAILBOX_CAP = 62  # max in-flight messages per recipient
 # --- Fixed-layout (non-protobuf) encoded sizes ---------------------------
 # The inner, channel-encrypted codec used by this framework is a raw fixed
 # layout (see wire/records.py). Sizes are constant by construction.
-REQUEST_RECORD_WIRE_SIZE = MSG_ID_SIZE + PUBKEY_SIZE + PAYLOAD_SIZE  # 984
-QUERY_REQUEST_WIRE_SIZE = 4 + PUBKEY_SIZE + SIGNATURE_SIZE + REQUEST_RECORD_WIRE_SIZE  # 1084
-QUERY_RESPONSE_WIRE_SIZE = RECORD_SIZE + 4  # 1028
+REQUEST_RECORD_WIRE_SIZE = MSG_ID_SIZE + PUBKEY_SIZE + PAYLOAD_SIZE  # 984 @1KB
+QUERY_REQUEST_WIRE_SIZE = 4 + PUBKEY_SIZE + SIGNATURE_SIZE + REQUEST_RECORD_WIRE_SIZE  # 1084 @1KB
+QUERY_RESPONSE_WIRE_SIZE = RECORD_SIZE + 4  # 1028 @1KB
 
 ZERO_MSG_ID = b"\x00" * MSG_ID_SIZE
 ZERO_PUBKEY = b"\x00" * PUBKEY_SIZE

@@ -19,9 +19,11 @@ the direct analog of the reference's two-type-stack tests.
 
 Fixed layouts (little-endian scalars):
 
-- ``RequestRecord``: msg_id(16) | recipient(32) | payload(936)          = 984
+- ``RequestRecord``: msg_id(16) | recipient(32) | payload — sizes derive
+  from wire/constants.py (984 bytes at the default 1024-byte record;
+  GRAPEVINE_RECORD_SIZE=2048 selects the reference's 2 KB option)
 - ``Record``:        msg_id(16) | sender(32) | recipient(32) |
-  timestamp(8) | payload(936)                                           = 1024
+  timestamp(8) | payload(C.PAYLOAD_SIZE)                 = C.RECORD_SIZE
   (field order matches the reference's table, README.md:132-136)
 - ``QueryRequest``:  request_type(4) | auth_identity(32) |
   auth_signature(64) | record(984)                                      = 1084
@@ -82,7 +84,7 @@ class RequestRecord:
 class Record:
     """A message in the bus: the unit that moves in and out of ORAM.
 
-    Exactly 1024 bytes packed (reference README.md:132-136); the payload is
+    Exactly C.RECORD_SIZE bytes packed (reference README.md:132-139); the payload is
     opaque to the service (reference README.md:146-157).
     """
 

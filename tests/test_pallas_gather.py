@@ -1,0 +1,130 @@
+"""Fused gather+decrypt kernel (oblivious/pallas_gather.py).
+
+Correctness contract: the fused single-pass fetch is bit-identical to
+gather → keystream XOR, at the kernel level and through a full engine
+round (interpret mode on CPU — the Mosaic compile is exercised on real
+TPU by bench.py's pallas configs)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.oblivious.bucket_cipher import row_keystream
+from grapevine_tpu.oblivious.pallas_gather import gather_decrypt_rows
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+
+def test_kernel_matches_gather_then_xor():
+    rng = np.random.default_rng(2)
+    n, z, v = 64, 4, 6
+    zv = z * v
+    tree_idx = jnp.asarray(rng.integers(0, 2**31, (n * z,)), jnp.uint32)
+    tree_val = jnp.asarray(rng.integers(0, 2**31, (n, zv)), jnp.uint32)
+    nonces = jnp.asarray(rng.integers(0, 3, (n, 2)), jnp.uint32)  # some 0
+    key = jnp.asarray(rng.integers(0, 2**31, (8,)), jnp.uint32)
+    flat_b = jnp.asarray(rng.integers(0, n, (17,)), jnp.uint32)
+    oi, ov = gather_decrypt_rows(
+        key, tree_idx, tree_val, nonces, flat_b, z=z, rounds=8,
+        interpret=True,
+    )
+    pidx = tree_idx.reshape(n, z)[flat_b]
+    pval = tree_val[flat_b]
+    pn = nonces[flat_b]
+    ks = row_keystream(key, flat_b, pn, z + zv, 8)
+    written = ((pn[:, 0] != 0) | (pn[:, 1] != 0))[:, None]
+    assert np.array_equal(
+        np.asarray(oi), np.asarray(pidx ^ jnp.where(written, ks[:, :z], 0))
+    )
+    assert np.array_equal(
+        np.asarray(ov), np.asarray(pval ^ jnp.where(written, ks[:, z:], 0))
+    )
+
+
+def test_plaintext_rounds0_is_plain_gather():
+    rng = np.random.default_rng(3)
+    n, z, zv = 16, 4, 8
+    tree_idx = jnp.asarray(rng.integers(0, 2**31, (n * z,)), jnp.uint32)
+    tree_val = jnp.asarray(rng.integers(0, 2**31, (n, zv)), jnp.uint32)
+    nonces = jnp.zeros((n, 2), jnp.uint32)
+    key = jnp.zeros((8,), jnp.uint32)
+    flat_b = jnp.asarray([3, 0, 3], jnp.uint32)
+    oi, ov = gather_decrypt_rows(
+        key, tree_idx, tree_val, nonces, flat_b, z=z, rounds=0,
+        interpret=True,
+    )
+    assert np.array_equal(np.asarray(oi), np.asarray(tree_idx.reshape(n, z)[flat_b]))
+    assert np.array_equal(np.asarray(ov), np.asarray(tree_val[flat_b]))
+
+
+def _run_crd(impl: str, seed: int = 9):
+    cfg = GrapevineConfig(
+        max_messages=64,
+        max_recipients=8,
+        mailbox_cap=4,
+        batch_size=4,
+        stash_size=64,
+        bucket_cipher_rounds=8,
+        bucket_cipher_impl=impl,
+    )
+    e = GrapevineEngine(cfg, seed=seed)
+    a, b = b"\x11" * 32, b"\x22" * 32
+    outs = []
+    r = e.handle_queries(
+        [QueryRequest(request_type=C.REQUEST_TYPE_CREATE, auth_identity=a,
+                      record=RequestRecord(recipient=b,
+                                           payload=b"\x05" * C.PAYLOAD_SIZE))],
+        NOW,
+    )[0]
+    outs.append((r.status_code, r.record.msg_id, r.record.payload))
+    r2 = e.handle_queries(
+        [QueryRequest(request_type=C.REQUEST_TYPE_READ, auth_identity=b,
+                      record=RequestRecord(msg_id=C.ZERO_MSG_ID))],
+        NOW + 1,
+    )[0]
+    outs.append((r2.status_code, r2.record.msg_id, r2.record.payload))
+    r3 = e.handle_queries(
+        [QueryRequest(request_type=C.REQUEST_TYPE_DELETE, auth_identity=b,
+                      record=RequestRecord(msg_id=C.ZERO_MSG_ID))],
+        NOW + 2,
+    )[0]
+    outs.append((r3.status_code, r3.record.msg_id, r3.record.payload))
+    return outs
+
+
+def test_engine_round_identical_across_cipher_impls():
+    """Full engine C-R-D through the fused fetch ≡ the jnp path (same
+    seed ⇒ same ids, payloads, statuses)."""
+    assert _run_crd("pallas_fused") == _run_crd("jnp")
+
+
+def test_sharded_path_ignores_fused_fetch():
+    """Under shard_map (axis_name set) the fused fetch must NOT engage —
+    the sharded program still compiles and matches single-chip (the
+    plaintext-over-ICI guard)."""
+    from grapevine_tpu.engine.state import EngineConfig, init_engine
+    from grapevine_tpu.engine.batcher import pack_batch
+    from grapevine_tpu.parallel import make_mesh, make_sharded_step, shard_engine_state
+
+    cfg = GrapevineConfig(
+        max_messages=64, max_recipients=8, mailbox_cap=4, batch_size=4,
+        stash_size=64, bucket_cipher_rounds=8,
+        bucket_cipher_impl="pallas_fused",
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:4])
+    state = shard_engine_state(init_engine(ecfg, seed=1), mesh)
+    step = make_sharded_step(ecfg, mesh)
+    req = QueryRequest(
+        request_type=C.REQUEST_TYPE_CREATE,
+        auth_identity=b"\x11" * 32,
+        record=RequestRecord(recipient=b"\x22" * 32,
+                             payload=b"\x07" * C.PAYLOAD_SIZE),
+    )
+    batch = pack_batch([req], 4, NOW)
+    state, resp, _ = step(state, batch)
+    assert int(np.asarray(resp["status"])[0]) == C.STATUS_CODE_SUCCESS
