@@ -151,8 +151,13 @@ class GrapevineEngine:
         self.ecfg = EngineConfig.from_config(self.config)
         self.state: EngineState = init_engine(self.ecfg, seed)
         step_fn = engine_round_step if self.config.commit == "phase" else engine_step
-        self._step = jax.jit(step_fn, static_argnums=(0,))
-        self._sweep = jax.jit(expiry_sweep, static_argnums=(0,))
+        # donate the state: trees update in place (no per-round copy,
+        # and the fused pallas scatter's input/output aliasing would
+        # otherwise force XLA to defensively copy both tree arrays)
+        self._step = jax.jit(step_fn, static_argnums=(0,), donate_argnums=(1,))
+        self._sweep = jax.jit(
+            expiry_sweep, static_argnums=(0,), donate_argnums=(1,)
+        )
         self._lock = threading.Lock()
         self.metrics = EngineMetrics()
 

@@ -40,16 +40,17 @@ U32 = jnp.uint32
 _TILE_BYTES = 1 << 21
 
 
-def _cipher_kernel(
-    key_ref, bucket_ref, epoch_ref, idx_ref, val_ref, oidx_ref, oval_ref,
-    *, nb, z, n_words, rounds,
-):
-    """One row tile: (idx [TR, z], val [TR, W-z]) ^= keystream rows."""
-    tr = idx_ref.shape[0]
+def keystream_tile(key_ref, n1, n2, n3, nb, rounds):
+    """ChaCha keystream for a [TR, nb]-shaped tile of rows, j-major.
+
+    ``n1/n2/n3`` are the per-row nonce words broadcast to [TR, nb];
+    the counter word is the block index within the row. The ONE copy
+    of the in-kernel ChaCha block shared by every Pallas cipher kernel
+    (this module's XOR kernel and pallas_gather.py's fused fetch and
+    write-back) — the round schedule and state layout cannot drift
+    between them."""
+    tr = n1.shape[0]
     ctr = jax.lax.broadcasted_iota(U32, (tr, nb), 1)
-    n1 = jnp.broadcast_to(bucket_ref[:][:, None], (tr, nb))
-    n2 = jnp.broadcast_to(epoch_ref[:, 0][:, None], (tr, nb))
-    n3 = jnp.broadcast_to(epoch_ref[:, 1][:, None], (tr, nb))
     init = [jnp.full((tr, nb), U32(c)) for c in _SIGMA]
     init += [jnp.broadcast_to(key_ref[0, i], (tr, nb)) for i in range(8)]
     init += [ctr, n1, n2, n3]
@@ -64,7 +65,19 @@ def _cipher_kernel(
         _qr(s, 2, 7, 8, 13)
         _qr(s, 3, 4, 9, 14)
     # j-major assembly: 16 contiguous [TR, nb] lane ranges
-    ks = jnp.concatenate([a + b for a, b in zip(s, init)], axis=1)
+    return jnp.concatenate([a + b for a, b in zip(s, init)], axis=1)
+
+
+def _cipher_kernel(
+    key_ref, bucket_ref, epoch_ref, idx_ref, val_ref, oidx_ref, oval_ref,
+    *, nb, z, n_words, rounds,
+):
+    """One row tile: (idx [TR, z], val [TR, W-z]) ^= keystream rows."""
+    tr = idx_ref.shape[0]
+    n1 = jnp.broadcast_to(bucket_ref[:][:, None], (tr, nb))
+    n2 = jnp.broadcast_to(epoch_ref[:, 0][:, None], (tr, nb))
+    n3 = jnp.broadcast_to(epoch_ref[:, 1][:, None], (tr, nb))
+    ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
     written = ((epoch_ref[:, 0] != U32(0)) | (epoch_ref[:, 1] != U32(0)))[:, None]
     oidx_ref[:, :] = idx_ref[:, :] ^ jnp.where(written, ks[:, :z], U32(0))
     oval_ref[:, :] = val_ref[:, :] ^ jnp.where(

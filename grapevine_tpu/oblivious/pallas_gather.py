@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .bucket_cipher import _SIGMA, _qr
+from .pallas_cipher import keystream_tile
 
 U32 = jnp.uint32
 
@@ -55,24 +55,10 @@ def _gather_kernel(
 ):
     i = pl.program_id(0)
     bid = bucket_ref[i]
-    ctr = jax.lax.broadcasted_iota(U32, (1, nb), 1)
     n1 = jnp.full((1, nb), bid, U32)
     n2 = jnp.broadcast_to(nonce_row_ref[0, 0], (1, nb))
     n3 = jnp.broadcast_to(nonce_row_ref[0, 1], (1, nb))
-    init = [jnp.full((1, nb), U32(c)) for c in _SIGMA]
-    init += [jnp.broadcast_to(key_ref[0, j], (1, nb)) for j in range(8)]
-    init += [ctr, n1, n2, n3]
-    s = list(init)
-    for _ in range(rounds // 2):
-        _qr(s, 0, 4, 8, 12)
-        _qr(s, 1, 5, 9, 13)
-        _qr(s, 2, 6, 10, 14)
-        _qr(s, 3, 7, 11, 15)
-        _qr(s, 0, 5, 10, 15)
-        _qr(s, 1, 6, 11, 12)
-        _qr(s, 2, 7, 8, 13)
-        _qr(s, 3, 4, 9, 14)
-    ks = jnp.concatenate([a + b for a, b in zip(s, init)], axis=1)
+    ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
     written = (nonce_row_ref[0, 0] != U32(0)) | (nonce_row_ref[0, 1] != U32(0))
     oidx_ref[0, :] = idx_row_ref[0, :] ^ jnp.where(written, ks[0, :z], U32(0))
     oval_ref[0, :] = val_row_ref[0, :] ^ jnp.where(
@@ -134,3 +120,112 @@ def gather_decrypt_rows(
         interpret=interpret,
     )(flat_b, key[None, :], idx_rows, tree_val, nonces)
     return oidx, oval
+
+
+def _scatter_kernel(
+    bucket_ref,  # scalar-prefetch: u32[R] write targets (junk-redirected)
+    key_ref,  # u32[1, 8]
+    idx_new_ref,  # u32[1, z]    plaintext row i to write
+    val_new_ref,  # u32[1, z*v]
+    epoch_ref,  # u32[1, 2]     write epoch (same for all rows)
+    tree_idx_in_ref,  # aliased input (unread; aliasing carries state)
+    tree_val_in_ref,  # aliased input (unread)
+    otree_idx_ref,  # u32[1, z]   aliased tree_idx row bucket_ref[i]
+    otree_val_ref,  # u32[1, zv]  aliased tree_val row bucket_ref[i]
+    *,
+    nb,
+    z,
+    n_words,
+    rounds,
+):
+    i = pl.program_id(0)
+    bid = bucket_ref[i]
+    n1 = jnp.full((1, nb), bid, U32)
+    n2 = jnp.broadcast_to(epoch_ref[0, 0], (1, nb))
+    n3 = jnp.broadcast_to(epoch_ref[0, 1], (1, nb))
+    ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
+    otree_idx_ref[0, :] = idx_new_ref[0, :] ^ ks[0, :z]
+    otree_val_ref[0, :] = val_new_ref[0, :] ^ ks[0, z:n_words]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("z", "rounds", "interpret"),
+    donate_argnums=(1, 2),
+)
+def scatter_encrypt_rows(
+    key: jax.Array,  # u32[8]
+    tree_idx: jax.Array,  # u32[n_padded * z] (flat; updated in place)
+    tree_val: jax.Array,  # u32[n_padded, z*v] (updated in place)
+    flat_b: jax.Array,  # u32[R] heap-bucket targets (public transcript)
+    owner: jax.Array,  # bool[R]; False rows must not write
+    epoch: jax.Array,  # u32[2] the write epoch for every owned row
+    new_pidx: jax.Array,  # u32[R, z] plaintext rows to commit
+    new_pval: jax.Array,  # u32[R, z*v]
+    z: int,
+    rounds: int,
+    interpret: bool = False,
+):
+    """Encrypt + write back owned path rows in ONE HBM pass.
+
+    The write-back mirror of :func:`gather_decrypt_rows`: each grid
+    step generates its row's keystream in VMEM and writes the
+    ciphertext straight into the (input/output-aliased) tree arrays —
+    the encrypted copy never exists as a separate HBM array, and rows
+    no grid step targets keep their contents via the aliasing.
+    Non-owner rows (duplicate-bucket fetch copies) are redirected to
+    the padded junk bucket, which heap indices never address; owner
+    targets are unique, so writes never conflict (the junk row takes
+    several writes — last wins, never read).
+
+    Returns the updated ``(tree_idx, tree_val)``.
+    """
+    n_padded = tree_val.shape[0]
+    zv = tree_val.shape[1]
+    r = flat_b.shape[0]
+    w = z + zv
+    nb = (w + 15) // 16
+    idx_rows = tree_idx.reshape(n_padded, z)
+    # non-owners write the junk row (n_padded - 1: heap indices stop at
+    # n_buckets = n_padded - 1, see OramConfig.n_buckets_padded)
+    junk = U32(n_padded - 1)
+    tgt = jnp.where(owner, flat_b, junk)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda i, b_ref: (0, 0)),
+            pl.BlockSpec((1, z), lambda i, b_ref: (i, 0)),
+            pl.BlockSpec((1, zv), lambda i, b_ref: (i, 0)),
+            pl.BlockSpec((1, 2), lambda i, b_ref: (0, 0)),
+            # aliased tree inputs: unread by the kernel (constant row-0
+            # block so the pipeline loads stay trivial)
+            pl.BlockSpec((1, z), lambda i, b_ref: (0, 0)),
+            pl.BlockSpec((1, zv), lambda i, b_ref: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)
+            ),
+            pl.BlockSpec(
+                (1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)
+            ),
+        ],
+    )
+    oidx, oval = pl.pallas_call(
+        functools.partial(
+            _scatter_kernel, nb=nb, z=z, n_words=w, rounds=rounds
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_padded, z), U32),
+            jax.ShapeDtypeStruct((n_padded, zv), U32),
+        ],
+        # operand indices count ALL inputs incl. the scalar prefetch:
+        # tgt=0, key=1, new_pidx=2, new_pval=3, epoch=4, idx_rows=5,
+        # tree_val=6
+        input_output_aliases={5: 0, 6: 1},
+        interpret=interpret,
+    )(tgt, key[None, :], new_pidx, new_pval, epoch[None, :], idx_rows, tree_val)
+    return oidx.reshape(-1), oval

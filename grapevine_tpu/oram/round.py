@@ -282,26 +282,43 @@ def oram_round(
     # shares the bucket's owner bit
     fowner_slots = jnp.repeat(fowner, z)
     epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
-    enc_pidx, enc_pval = cipher_rows(
-        cfg,
-        state.cipher_key,
-        flat_b,
-        epochs_w,
-        new_pidx.reshape(b * plen, z),
-        new_pval.reshape(b * plen, z * v),
-    )
+    if axis_name is None and cfg.cipher_impl == "pallas_fused" and cfg.encrypted:
+        # single-chip fast path: encrypt + scatter in ONE HBM pass (the
+        # write-back mirror of the fused fetch; pallas_gather.py)
+        from ..oblivious.pallas_gather import scatter_encrypt_rows
+
+        tree_idx_new, tree_val_new = scatter_encrypt_rows(
+            state.cipher_key, state.tree_idx, state.tree_val, flat_b,
+            fowner, state.epoch,
+            new_pidx.reshape(b * plen, z),
+            new_pval.reshape(b * plen, z * v),
+            z=z, rounds=cfg.cipher_rounds,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        enc_pidx, enc_pval = cipher_rows(
+            cfg,
+            state.cipher_key,
+            flat_b,
+            epochs_w,
+            new_pidx.reshape(b * plen, z),
+            new_pval.reshape(b * plen, z * v),
+        )
+        tree_idx_new = _path_scatter(
+            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name,
+            fowner_slots,
+        )
+        tree_val_new = _path_scatter(
+            state.tree_val, flat_b, enc_pval, axis_name, fowner
+        )
     nonces = (
         _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
         if cfg.encrypted
         else state.nonces
     )
     new_state = OramState(
-        tree_idx=_path_scatter(
-            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name, fowner_slots
-        ),
-        tree_val=_path_scatter(
-            state.tree_val, flat_b, enc_pval, axis_name, fowner
-        ),
+        tree_idx=tree_idx_new,
+        tree_val=tree_val_new,
         stash_idx=stash_idx,
         stash_val=stash_val,
         posmap=posmap,

@@ -128,3 +128,47 @@ def test_sharded_path_ignores_fused_fetch():
     batch = pack_batch([req], 4, NOW)
     state, resp, _ = step(state, batch)
     assert int(np.asarray(resp["status"])[0]) == C.STATUS_CODE_SUCCESS
+
+
+def test_scatter_encrypt_matches_encrypt_then_scatter():
+    """The fused write-back ≡ cipher_rows → masked scatter: owners'
+    rows land encrypted, non-owner duplicates are dropped, untouched
+    rows (and nothing else) keep their exact contents."""
+    from grapevine_tpu.oblivious.pallas_gather import scatter_encrypt_rows
+
+    rng = np.random.default_rng(5)
+    n, z, v = 32, 4, 6
+    zv = z * v
+    tree_idx = jnp.asarray(rng.integers(0, 2**31, (n * z,)), jnp.uint32)
+    tree_val = jnp.asarray(rng.integers(0, 2**31, (n, zv)), jnp.uint32)
+    key = jnp.asarray(rng.integers(0, 2**31, (8,)), jnp.uint32)
+    epoch = jnp.asarray([7, 0], jnp.uint32)
+    flat_b = jnp.asarray([3, 9, 3, 20], jnp.uint32)  # 3 duplicated
+    owner = jnp.asarray([True, True, False, True])
+    new_pidx = jnp.asarray(rng.integers(0, 2**31, (4, z)), jnp.uint32)
+    new_pval = jnp.asarray(rng.integers(0, 2**31, (4, zv)), jnp.uint32)
+    # snapshot BEFORE the call: the kernel donates the tree buffers
+    # (in-place update is the point), so the inputs die with the call
+    orig_i = np.asarray(tree_idx).reshape(n, z).copy()
+    orig_v = np.asarray(tree_val).copy()
+    oi, ov = scatter_encrypt_rows(
+        key, tree_idx, tree_val, flat_b, owner, epoch, new_pidx, new_pval,
+        z=z, rounds=8, interpret=True,
+    )
+    oi = np.asarray(oi).reshape(n, z)
+    ov = np.asarray(ov)
+    ks = row_keystream(
+        key, flat_b, jnp.broadcast_to(epoch[None, :], (4, 2)), z + zv, 8
+    )
+    ref_i, ref_v = orig_i.copy(), orig_v.copy()
+    for j in range(4):
+        if bool(owner[j]):
+            ref_i[int(flat_b[j])] = np.asarray(new_pidx[j] ^ ks[j, :z])
+            ref_v[int(flat_b[j])] = np.asarray(new_pval[j] ^ ks[j, z:])
+    for row in range(n - 1):  # row n-1 is the junk pad bucket
+        if row in (3, 9, 20):
+            assert np.array_equal(oi[row], ref_i[row]), f"idx row {row}"
+            assert np.array_equal(ov[row], ref_v[row]), f"val row {row}"
+        else:
+            assert np.array_equal(oi[row], orig_i[row]), row
+            assert np.array_equal(ov[row], orig_v[row]), row
