@@ -7,6 +7,8 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
   3. zipf_mixed          mixed CRUD, Zipf recipient keys, 62-cap stress
   3b. zipf_pallas_cipher the same workload through the fused Pallas
                          cipher kernel (TPU backends only)
+  3c. zipf_pallas_fused  …plus the path fetch and write-back fused
+                         into the cipher passes (Mosaic backends only)
   4. expiry_sweep        timestamped eviction scan, 2^22 at density 4
   5. sharded             bucket-tree sharded over a device mesh (CPU
                          mesh subprocess when one chip is visible)
