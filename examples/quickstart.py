@@ -25,6 +25,7 @@ jax.config.update("jax_platforms", _platform)
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.server.client import GrapevineClient
 from grapevine_tpu.server.service import GrapevineServer
+from grapevine_tpu.session.channel import ServerIdentity
 from grapevine_tpu.wire import constants as C
 
 
@@ -36,21 +37,31 @@ def main():
         batch_size=8,             # ops per oblivious round
         expiry_period=3600,       # seconds until messages expire
     )
-    server = GrapevineServer(config=cfg)
+    # a STABLE static key (IX handshake): clients pin it to reject MITM.
+    # DEMO-ONLY SEED — anyone can derive this key. Production: derive
+    # from a SECRET 32-byte seed (or ServerIdentity.generate()) and
+    # distribute identity.public to clients out of band.
+    identity = ServerIdentity.from_seed(b"demo-server-identity-seed-32byte")
+    server = GrapevineServer(config=cfg, identity=identity)
     port = server.start("insecure-grapevine://127.0.0.1:0")
     print(f"server listening on insecure-grapevine://127.0.0.1:{port}")
+    print(f"server static key (pin me): {identity.public.hex()[:16]}…")
 
     # -- clients: Alice and Bob -----------------------------------------
-    # identity = a ristretto255 keypair derived from a 32-byte seed
+    # identity = a ristretto255 keypair derived from a 32-byte seed;
+    # server_static pins the IX-authenticated server key (an active
+    # MITM that substitutes its own identity is rejected at auth())
     alice = GrapevineClient(
-        f"insecure-grapevine://127.0.0.1:{port}", identity_seed=b"A" * 32
+        f"insecure-grapevine://127.0.0.1:{port}", identity_seed=b"A" * 32,
+        server_static=identity.public,
     )
     bob = GrapevineClient(
-        f"insecure-grapevine://127.0.0.1:{port}", identity_seed=b"B" * 32
+        f"insecure-grapevine://127.0.0.1:{port}", identity_seed=b"B" * 32,
+        server_static=identity.public,
     )
-    alice.auth()  # X25519 handshake; seeds the challenge RNG lockstep
+    alice.auth()  # IX handshake; pins the static, seeds the lockstep RNG
     bob.auth()
-    print("clients authenticated (challenge RNG in lockstep with server)")
+    print("clients authenticated (server pinned; challenge RNG in lockstep)")
 
     # -- create: Alice -> Bob -------------------------------------------
     payload = b"hello, oblivious world".ljust(C.PAYLOAD_SIZE, b"\x00")
