@@ -131,3 +131,57 @@ def test_scheme_registry():
     assert get_signature_scheme("rfc9496") is ristretto
     with pytest.raises(ValueError):
         get_signature_scheme("ed25519")
+
+
+# Substrate's well-known sr25519 dev keypairs (`subkey inspect //Alice`
+# etc.) — externally published (seed, public) byte pairs this codebase
+# did not generate. sp-core expands the mini secret with schnorrkel's
+# ExpandMode::Ed25519, so reproducing public from seed transits SHA-512
+# expansion, ed25519 clamping, divide-by-cofactor, ristretto255
+# scalar*basepoint and compressed encoding against a foreign stack.
+_SUBSTRATE_DEV_VECTORS = [
+    (  # //Alice (SS58 5GrwvaEF5zXb26Fz9rcQpDWS57CtERHpNehXCPcNoHGKutQY)
+        "e5be9a5092b81bca64be81d212e7f2f9eba183bb7a90954f7b76361f6edb5c0a",
+        "d43593c715fdd31c61141abd04a99fd6822c8558854ccde39a5684e7a56da27d",
+    ),
+    (  # //Bob (SS58 5FHneW46xGXgs5mUiveU4sbTyGBzmstUspZC92UhjJM694ty)
+        "398f0c28f98885e046333d4a41c19cee4c37368a9832c6502f6cfd182e2aef89",
+        "8eaf04151687736326c9fea17e25fc5287613693c912909cb226aa4794f26a48",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed_hex,pub_hex", _SUBSTRATE_DEV_VECTORS)
+def test_expand_mini_secret_substrate_vectors(seed_hex, pub_hex):
+    sk, nonce = schnorrkel.expand_mini_secret(bytes.fromhex(seed_hex))
+    assert len(nonce) == 32
+    assert schnorrkel.public_key(sk).hex() == pub_hex
+
+
+@pytest.mark.parametrize("seed_hex,pub_hex", _SUBSTRATE_DEV_VECTORS)
+def test_expand_mini_secret_substrate_vectors_pure_python(seed_hex, pub_hex):
+    """Same vectors with the native r255.c path disabled: pins the pure
+    Python group arithmetic independently."""
+    native = ristretto._native.lib
+    ristretto.public_key.cache_clear()
+    try:
+        ristretto._native.lib = None
+        sk, _ = schnorrkel.expand_mini_secret(bytes.fromhex(seed_hex))
+        assert schnorrkel.public_key(sk).hex() == pub_hex
+    finally:
+        ristretto._native.lib = native
+        ristretto.public_key.cache_clear()
+
+
+def test_expanded_dev_key_signs_and_verifies():
+    """The expanded //Alice secret is a working signing key here."""
+    sk, _ = schnorrkel.expand_mini_secret(
+        bytes.fromhex(_SUBSTRATE_DEV_VECTORS[0][0]))
+    ctx, msg = b"grapevine-challenge", b"\x07" * 32
+    sig = schnorrkel.sign(sk, ctx, msg)
+    assert schnorrkel.verify(schnorrkel.public_key(sk), ctx, msg, sig)
+
+
+def test_expand_mini_secret_rejects_bad_length():
+    with pytest.raises(ValueError):
+        schnorrkel.expand_mini_secret(b"\x00" * 31)

@@ -1,0 +1,38 @@
+#!/bin/bash
+# TPU relay probe loop (VERDICT r4 next-round #1: "retry periodically
+# all round"). Appends one line per attempt to PROBELOG_r5.md; on the
+# first success it writes /tmp/TPU_UP and exits so the session can run
+# the heavy TPU work serialized (the relay is one weak core).
+LOG=/root/repo/PROBELOG_r5.md
+if [ ! -f "$LOG" ]; then
+  {
+    echo "# TPU relay probe log — round 5"
+    echo
+    echo "One line per attempt. Probe = 256x256 matmul on the default"
+    echo "backend in a fresh subprocess, 300 s timeout (bench.py's probe)."
+    echo
+  } >> "$LOG"
+fi
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 300 python - <<'EOF' 2>&1
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((256, 256), jnp.float32)
+(x @ x).block_until_ready()
+print(f"PROBE_OK {jax.default_backend()} {len(jax.devices())}dev {time.time()-t0:.1f}s")
+EOF
+)
+  rc=$?
+  line=$(echo "$out" | grep PROBE_OK | head -1)
+  if [ -n "$line" ]; then
+    echo "- $ts: **UP** — $line" >> "$LOG"
+    echo "$ts $line" > /tmp/TPU_UP
+    exit 0
+  else
+    err=$(echo "$out" | tail -1 | cut -c1-120)
+    [ $rc -eq 124 ] && err="timeout after 300s"
+    echo "- $ts: down (rc=$rc; $err)" >> "$LOG"
+  fi
+  sleep 420
+done
