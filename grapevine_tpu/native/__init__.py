@@ -79,6 +79,26 @@ def _bind(handle):
     handle.r255_mult_base.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     handle.r255_keccak_f1600.restype = None
     handle.r255_keccak_f1600.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    handle.r255_strobe_op.restype = ctypes.c_int
+    handle.r255_strobe_op.argtypes = [
+        ctypes.POINTER(ctypes.c_char), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+    ]
+    handle.r255_merlin_append.restype = None
+    handle.r255_merlin_append.argtypes = [
+        ctypes.POINTER(ctypes.c_char), ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    handle.r255_merlin_challenge.restype = None
+    handle.r255_merlin_challenge.argtypes = [
+        ctypes.POINTER(ctypes.c_char), ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_char), ctypes.c_size_t,
+    ]
+    handle.r255_schnorrkel_challenge.restype = None
+    handle.r255_schnorrkel_challenge.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
+    ]
     if handle.r255_init() != 0:
         return None
     return handle
@@ -124,3 +144,51 @@ def mult_base(scalar_le: bytes) -> bytes | None:
     with _lock:
         rc = lib.r255_mult_base(out, scalar_le)
     return bytes(out.raw) if rc == 0 else None
+
+
+# -- STROBE-128 / merlin transcript ops (session/merlin.py hot path) ---
+# No module lock on any of these: the C functions touch only the
+# caller's 203-byte blob (state ‖ pos ‖ pos_begin ‖ cur_flags), so
+# concurrent calls on distinct transcripts are safe.
+
+def strobe_op(blob: bytearray, op: int, data: bytes, more: bool) -> int:
+    """One STROBE op: 0=meta_ad 1=ad 3=key. Returns 0, or <0 on a
+    continued-op flag mismatch (caller raises)."""
+    buf = (ctypes.c_char * 203).from_buffer(blob)
+    return lib.r255_strobe_op(buf, op, data, len(data), None, 1 if more else 0)
+
+
+def strobe_prf(blob: bytearray, n: int, more: bool) -> bytes | None:
+    """PRF squeeze of ``n`` bytes; None on flag mismatch."""
+    buf = (ctypes.c_char * 203).from_buffer(blob)
+    out = ctypes.create_string_buffer(n)
+    rc = lib.r255_strobe_op(buf, 2, None, n, out, 1 if more else 0)
+    return bytes(out.raw) if rc == 0 else None
+
+
+def merlin_append(blob: bytearray, label: bytes, message: bytes) -> None:
+    """merlin append_message in one crossing (meta_ad + len + ad)."""
+    buf = (ctypes.c_char * 203).from_buffer(blob)
+    lib.r255_merlin_append(buf, label, len(label), message, len(message))
+
+
+def merlin_challenge(blob: bytearray, label: bytes, n: int) -> bytes:
+    """merlin challenge_bytes in one crossing (meta_ad + len + PRF)."""
+    buf = (ctypes.c_char * 203).from_buffer(blob)
+    out = ctypes.create_string_buffer(n)
+    lib.r255_merlin_challenge(buf, label, len(label), out, n)
+    return bytes(out.raw)
+
+
+def schnorrkel_challenge(
+    prefix_blob: bytes, message: bytes, pub: bytes, r_enc: bytes
+) -> bytes:
+    """64 challenge bytes from the cached SigningContext prefix in ONE
+    crossing (clone + 4 appends + PRF; schnorrkel sign.rs labels).
+    ``prefix_blob`` is the 203-byte transcript blob after
+    ``Transcript(b"SigCtx")`` + ``append_message(b"", context)``."""
+    out = ctypes.create_string_buffer(64)
+    lib.r255_schnorrkel_challenge(
+        bytes(prefix_blob), message, len(message), pub, r_enc, out
+    )
+    return bytes(out.raw)

@@ -575,3 +575,156 @@ void r255_keccak_f1600(uint8_t state[200]) {
         for (int j = 0; j < 8; j++) { state[8 * i + j] = (uint8_t)v; v >>= 8; }
     }
 }
+
+/* ------------------------------------------------------------------ */
+/* STROBE-128 duplex (the trimmed subset merlin embeds) over the      */
+/* permutation above — the per-request signature hot path runs ~8     */
+/* transcript ops per challenge derivation, and the Python framing    */
+/* (session/merlin.py) costs ~85 us/challenge; these C ops cut that   */
+/* to single-digit us. Layout: one 203-byte blob shared with Python:  */
+/*   [0..200) keccak state | [200] pos | [201] pos_begin | [202] cur_flags */
+/* merlin.py's pure-Python Strobe128 is the correctness oracle.       */
+/* ------------------------------------------------------------------ */
+
+#define STROBE_R 166
+#define SF_I 1
+#define SF_A 2
+#define SF_C 4
+#define SF_T 8
+#define SF_M 16
+#define SF_K 32
+
+static void strobe_run_f(uint8_t *b) {
+    b[b[200]] ^= b[201];
+    b[b[200] + 1] ^= 0x04;
+    b[STROBE_R + 1] ^= 0x80;
+    r255_keccak_f1600(b);
+    b[200] = 0;
+    b[201] = 0;
+}
+
+static void strobe_absorb(uint8_t *b, const uint8_t *d, size_t n) {
+    uint8_t pos = b[200];
+    for (size_t i = 0; i < n; i++) {
+        b[pos++] ^= d[i];
+        if (pos == STROBE_R) {
+            b[200] = pos;
+            strobe_run_f(b);
+            pos = 0;
+        }
+    }
+    b[200] = pos;
+}
+
+static void strobe_overwrite(uint8_t *b, const uint8_t *d, size_t n) {
+    uint8_t pos = b[200];
+    for (size_t i = 0; i < n; i++) {
+        b[pos++] = d[i];
+        if (pos == STROBE_R) {
+            b[200] = pos;
+            strobe_run_f(b);
+            pos = 0;
+        }
+    }
+    b[200] = pos;
+}
+
+static void strobe_squeeze(uint8_t *b, uint8_t *out, size_t n) {
+    uint8_t pos = b[200];
+    for (size_t i = 0; i < n; i++) {
+        out[i] = b[pos];
+        b[pos++] = 0;
+        if (pos == STROBE_R) {
+            b[200] = pos;
+            strobe_run_f(b);
+            pos = 0;
+        }
+    }
+    b[200] = pos;
+}
+
+static int strobe_begin_op(uint8_t *b, uint8_t flags, int more) {
+    if (more) return flags == b[202] ? 0 : -1;
+    if (flags & SF_T) return -2;
+    uint8_t header[2];
+    header[0] = b[201];           /* old pos_begin */
+    header[1] = flags;
+    b[201] = b[200] + 1;
+    b[202] = flags;
+    strobe_absorb(b, header, 2);
+    if ((flags & (SF_C | SF_K)) && b[200] != 0) strobe_run_f(b);
+    return 0;
+}
+
+/* op: 0 = meta_ad, 1 = ad, 2 = prf (data unused, out filled), 3 = key */
+int r255_strobe_op(uint8_t *b, int op, const uint8_t *data, size_t n,
+                   uint8_t *out, int more) {
+    int rc;
+    switch (op) {
+    case 0:
+        rc = strobe_begin_op(b, SF_M | SF_A, more);
+        if (rc) return rc;
+        strobe_absorb(b, data, n);
+        return 0;
+    case 1:
+        rc = strobe_begin_op(b, SF_A, more);
+        if (rc) return rc;
+        strobe_absorb(b, data, n);
+        return 0;
+    case 2:
+        rc = strobe_begin_op(b, SF_I | SF_A | SF_C, more);
+        if (rc) return rc;
+        strobe_squeeze(b, out, n);
+        return 0;
+    case 3:
+        rc = strobe_begin_op(b, SF_A | SF_C, more);
+        if (rc) return rc;
+        strobe_overwrite(b, data, n);
+        return 0;
+    }
+    return -3;
+}
+
+/* merlin append_message: meta_ad(label) ‖ meta_ad(LE32(len), more) ‖ ad(msg)
+   — one library crossing instead of three (transcript.rs framing). */
+void r255_merlin_append(uint8_t *b, const uint8_t *label, size_t llen,
+                        const uint8_t *msg, size_t mlen) {
+    uint8_t le[4] = {(uint8_t)mlen, (uint8_t)(mlen >> 8),
+                     (uint8_t)(mlen >> 16), (uint8_t)(mlen >> 24)};
+    strobe_begin_op(b, SF_M | SF_A, 0);
+    strobe_absorb(b, label, llen);
+    strobe_absorb(b, le, 4);
+    strobe_begin_op(b, SF_A, 0);
+    strobe_absorb(b, msg, mlen);
+}
+
+/* merlin challenge_bytes: meta_ad(label) ‖ meta_ad(LE32(n), more) ‖ PRF(n). */
+void r255_merlin_challenge(uint8_t *b, const uint8_t *label, size_t llen,
+                           uint8_t *out, size_t n) {
+    uint8_t le[4] = {(uint8_t)n, (uint8_t)(n >> 8), (uint8_t)(n >> 16),
+                     (uint8_t)(n >> 24)};
+    strobe_begin_op(b, SF_M | SF_A, 0);
+    strobe_absorb(b, label, llen);
+    strobe_absorb(b, le, 4);
+    strobe_begin_op(b, SF_I | SF_A | SF_C, 0);
+    strobe_squeeze(b, out, n);
+}
+
+/* The full schnorrkel Fiat–Shamir challenge in one crossing: clone the
+   cached SigningContext prefix (203-byte blob), absorb the message and
+   the sign.rs label sequence, squeeze 64 challenge bytes. Labels are
+   schnorrkel-og 0.11 sign.rs/context.rs; session/merlin.py's Python
+   framing is the oracle (tests/test_merlin.py equivalence). */
+void r255_schnorrkel_challenge(const uint8_t *prefix_blob,
+                               const uint8_t *msg, size_t mlen,
+                               const uint8_t *pub, const uint8_t *r_enc,
+                               uint8_t *out64) {
+    uint8_t b[203];
+    memcpy(b, prefix_blob, 203);
+    r255_merlin_append(b, (const uint8_t *)"sign-bytes", 10, msg, mlen);
+    r255_merlin_append(b, (const uint8_t *)"proto-name", 10,
+                       (const uint8_t *)"Schnorr-sig", 11);
+    r255_merlin_append(b, (const uint8_t *)"sign:pk", 7, pub, 32);
+    r255_merlin_append(b, (const uint8_t *)"sign:R", 6, r_enc, 32);
+    r255_merlin_challenge(b, (const uint8_t *)"sign:c", 6, out64, 64);
+}

@@ -106,109 +106,172 @@ _FLAG_M = 1 << 4
 _FLAG_K = 1 << 5
 
 
-class Strobe128:
-    """The trimmed STROBE-128 duplex merlin embeds (merlin strobe.rs)."""
+def _native_strobe():
+    """The native module iff the STROBE ops are loaded, else None."""
+    from .. import native as _native
 
-    __slots__ = ("state", "pos", "pos_begin", "cur_flags")
+    return _native if _native.lib is not None else None
+
+
+class Strobe128:
+    """The trimmed STROBE-128 duplex merlin embeds (merlin strobe.rs).
+
+    The whole duplex lives in one 203-byte blob —
+    ``state[200] ‖ pos ‖ pos_begin ‖ cur_flags`` — shared byte-for-byte
+    with the C ops in native/r255.c, so a transcript can move freely
+    between the native fast path (one library crossing per op) and the
+    pure-Python oracle below. The per-request signature path runs ~8
+    transcript ops per challenge; the Python framing alone cost ~85 µs
+    before the C ops (measured, PERF.md host table)."""
+
+    __slots__ = ("blob",)
 
     def __init__(self, protocol_label: bytes):
-        st = bytearray(200)
-        st[0:6] = bytes([1, _STROBE_R + 2, 1, 0, 1, 96])
-        st[6:18] = b"STROBEv1.0.2"
-        keccak_f1600(st)
-        self.state = st
-        self.pos = 0
-        self.pos_begin = 0
-        self.cur_flags = 0
+        blob = bytearray(203)
+        blob[0:6] = bytes([1, _STROBE_R + 2, 1, 0, 1, 96])
+        blob[6:18] = b"STROBEv1.0.2"
+        self.blob = blob
+        self._f1600()
         self.meta_ad(protocol_label, False)
 
-    def _run_f(self) -> None:
-        self.state[self.pos] ^= self.pos_begin
-        self.state[self.pos + 1] ^= 0x04
-        self.state[_STROBE_R + 1] ^= 0x80
-        keccak_f1600(self.state)
-        self.pos = 0
-        self.pos_begin = 0
+    # -- pos / pos_begin / cur_flags live in the blob tail ---------------
 
-    # the duplex ops work in rate-bounded slices, not per byte: the
-    # transcript layer sits on the per-request signature hot path, and a
-    # byte-at-a-time loop costs tens of µs per signature for no reason
+    @property
+    def pos(self) -> int:
+        return self.blob[200]
+
+    @property
+    def pos_begin(self) -> int:
+        return self.blob[201]
+
+    @property
+    def cur_flags(self) -> int:
+        return self.blob[202]
+
+    def _f1600(self) -> None:
+        """Permute the first 200 blob bytes in place.
+
+        Dispatches on the native *library* directly (not the STROBE-op
+        dispatch hook): the C permutation predates the C duplex ops, so
+        a pure-Python-framing configuration must still use it — that is
+        the configuration that actually shipped before the duplex moved
+        to C, and what tools/host_ceiling.py --legacy reproduces."""
+        from .. import native as _native
+
+        if _native.lib is not None:
+            _native.keccak_f1600(self.blob)  # c_char*200 view, 203 buffer
+        else:
+            st = bytearray(self.blob[:200])
+            _keccak_f1600_py(st)
+            self.blob[:200] = st
+
+    def _run_f(self) -> None:
+        b = self.blob
+        b[b[200]] ^= b[201]
+        b[b[200] + 1] ^= 0x04
+        b[_STROBE_R + 1] ^= 0x80
+        self._f1600()
+        b[200] = 0
+        b[201] = 0
+
+    # the pure-Python duplex ops work in rate-bounded slices, not per
+    # byte; they are the oracle for the C ops (tests/test_merlin.py
+    # cross-checks every op against this path)
 
     def _absorb(self, data: bytes) -> None:
-        i, n, st = 0, len(data), self.state
+        i, n, b = 0, len(data), self.blob
         while i < n:
-            take = min(_STROBE_R - self.pos, n - i)
-            p = self.pos
-            st[p : p + take] = (
-                int.from_bytes(st[p : p + take], "little")
+            take = min(_STROBE_R - b[200], n - i)
+            p = b[200]
+            b[p : p + take] = (
+                int.from_bytes(b[p : p + take], "little")
                 ^ int.from_bytes(data[i : i + take], "little")
             ).to_bytes(take, "little")
-            self.pos += take
+            b[200] += take
             i += take
-            if self.pos == _STROBE_R:
+            if b[200] == _STROBE_R:
                 self._run_f()
 
     def _overwrite(self, data: bytes) -> None:
-        i, n, st = 0, len(data), self.state
+        i, n, b = 0, len(data), self.blob
         while i < n:
-            take = min(_STROBE_R - self.pos, n - i)
-            st[self.pos : self.pos + take] = data[i : i + take]
-            self.pos += take
+            take = min(_STROBE_R - b[200], n - i)
+            b[b[200] : b[200] + take] = data[i : i + take]
+            b[200] += take
             i += take
-            if self.pos == _STROBE_R:
+            if b[200] == _STROBE_R:
                 self._run_f()
 
     def _squeeze(self, n: int) -> bytes:
         out = bytearray(n)
-        i, st = 0, self.state
+        i, b = 0, self.blob
         while i < n:
-            take = min(_STROBE_R - self.pos, n - i)
-            out[i : i + take] = st[self.pos : self.pos + take]
-            st[self.pos : self.pos + take] = bytes(take)
-            self.pos += take
+            take = min(_STROBE_R - b[200], n - i)
+            out[i : i + take] = b[b[200] : b[200] + take]
+            b[b[200] : b[200] + take] = bytes(take)
+            b[200] += take
             i += take
-            if self.pos == _STROBE_R:
+            if b[200] == _STROBE_R:
                 self._run_f()
         return bytes(out)
 
     def _begin_op(self, flags: int, more: bool) -> None:
+        b = self.blob
         if more:
-            if flags != self.cur_flags:
+            if flags != b[202]:
                 raise ValueError(
-                    f"continued op flag mismatch: {flags} != {self.cur_flags}"
+                    f"continued op flag mismatch: {flags} != {b[202]}"
                 )
             return
         if flags & _FLAG_T:
             raise ValueError("transport ops unsupported in merlin strobe")
-        old_begin = self.pos_begin
-        self.pos_begin = self.pos + 1
-        self.cur_flags = flags
+        old_begin = b[201]
+        b[201] = b[200] + 1
+        b[202] = flags
         self._absorb(bytes([old_begin, flags]))
-        if (flags & (_FLAG_C | _FLAG_K)) and self.pos != 0:
+        if (flags & (_FLAG_C | _FLAG_K)) and b[200] != 0:
             self._run_f()
 
     def meta_ad(self, data: bytes, more: bool) -> None:
+        nat = _native_strobe()
+        if nat is not None:
+            if nat.strobe_op(self.blob, 0, bytes(data), more):
+                raise ValueError("continued op flag mismatch")
+            return
         self._begin_op(_FLAG_M | _FLAG_A, more)
         self._absorb(data)
 
     def ad(self, data: bytes, more: bool) -> None:
+        nat = _native_strobe()
+        if nat is not None:
+            if nat.strobe_op(self.blob, 1, bytes(data), more):
+                raise ValueError("continued op flag mismatch")
+            return
         self._begin_op(_FLAG_A, more)
         self._absorb(data)
 
     def prf(self, n: int, more: bool) -> bytes:
+        nat = _native_strobe()
+        if nat is not None:
+            out = nat.strobe_prf(self.blob, n, more)
+            if out is None:
+                raise ValueError("continued op flag mismatch")
+            return out
         self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C, more)
         return self._squeeze(n)
 
     def key(self, data: bytes, more: bool) -> None:
+        nat = _native_strobe()
+        if nat is not None:
+            if nat.strobe_op(self.blob, 3, bytes(data), more):
+                raise ValueError("continued op flag mismatch")
+            return
         self._begin_op(_FLAG_A | _FLAG_C, more)
         self._overwrite(data)
 
     def clone(self) -> "Strobe128":
         dup = object.__new__(Strobe128)
-        dup.state = bytearray(self.state)
-        dup.pos = self.pos
-        dup.pos_begin = self.pos_begin
-        dup.cur_flags = self.cur_flags
+        dup.blob = bytearray(self.blob)
         return dup
 
 
@@ -222,6 +285,11 @@ class Transcript:
         self.append_message(b"dom-sep", label)
 
     def append_message(self, label: bytes, message: bytes) -> None:
+        nat = _native_strobe()
+        if nat is not None:
+            # one library crossing for the whole merlin framing
+            nat.merlin_append(self.strobe.blob, bytes(label), bytes(message))
+            return
         self.strobe.meta_ad(label, False)
         self.strobe.meta_ad(struct.pack("<I", len(message)), True)
         self.strobe.ad(message, False)
@@ -230,6 +298,9 @@ class Transcript:
         self.append_message(label, struct.pack("<Q", value))
 
     def challenge_bytes(self, label: bytes, n: int) -> bytes:
+        nat = _native_strobe()
+        if nat is not None:
+            return nat.merlin_challenge(self.strobe.blob, bytes(label), n)
         self.strobe.meta_ad(label, False)
         self.strobe.meta_ad(struct.pack("<I", n), True)
         return self.strobe.prf(n, False)

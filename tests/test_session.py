@@ -155,3 +155,28 @@ def test_fixed_base_mult_matches_naive():
 
     for s in [1, 2, 7, R.L - 1, 0xDEADBEEF1234567890ABCDEF]:
         assert R._fixed_base_mult(s) == (s * R.BASEPOINT)
+
+
+def test_chacha_openssl_matches_pure_python():
+    """The OpenSSL-backed keystream is the same RFC 7539 stream as the
+    pure-Python block function, across partial-block draw patterns."""
+    from grapevine_tpu.session import chacha
+
+    key = bytes(range(32))
+    for pattern in [(32,) * 8, (1, 63, 64, 65, 13, 200), (7,) * 40, (256,)]:
+        fast = chacha.ChaCha20(key)
+        pure = chacha.ChaCha20(key)
+        assert fast._openssl is not None, "OpenSSL backend missing"
+        pure._openssl = None  # force the spec-oracle path
+        for n in pattern:
+            assert fast.keystream(n) == pure.keystream(n), (pattern, n)
+
+
+def test_chacha_openssl_nonzero_counter():
+    from grapevine_tpu.session import chacha
+
+    key = b"\x42" * 32
+    fast = chacha.ChaCha20(key, counter=7)
+    pure = chacha.ChaCha20(key, counter=7)
+    pure._openssl = None
+    assert fast.keystream(100) == pure.keystream(100)
