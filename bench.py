@@ -294,12 +294,15 @@ def _fused_plumbing_proof():
     from a TPU backend run of this same config)."""
     import jax
 
+    from grapevine_tpu.engine.state import ID_WORDS, KEY_WORDS, PAYLOAD_WORDS
+
     cfg, ecfg, state, step = _mk_engine(1 << 6, 1 << 3, 2, cipher_impl="pallas_fused")
     rng = np.random.default_rng(5)
-    me = rng.integers(1, 2**31, (8,)).astype(np.uint32)
-    pl = rng.integers(0, 2**31, (234,)).astype(np.uint32)
-    zid = np.zeros((4,), np.uint32)
-    reqs = [(1, me, zid, me, pl), (2, me, zid, np.zeros(8, np.uint32), pl)]
+    me = rng.integers(1, 2**31, (KEY_WORDS,)).astype(np.uint32)
+    pl = rng.integers(0, 2**31, (PAYLOAD_WORDS,)).astype(np.uint32)
+    zid = np.zeros((ID_WORDS,), np.uint32)
+    zkey = np.zeros((KEY_WORDS,), np.uint32)
+    reqs = [(1, me, zid, me, pl), (2, me, zid, zkey, pl)]
     b = _batch_arrays(reqs, ecfg)
     t0 = time.perf_counter()
     state, resp, _ = step(ecfg, state, b)

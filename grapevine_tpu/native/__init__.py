@@ -6,9 +6,15 @@ reference's api/build.rs protoc step). If no compiler is available the
 package degrades to the pure-Python paths — callers must treat ``lib``
 as Optional.
 
-Thread-safety: the C library uses static scratch buffers (it is called
-from the scheduler's single collector thread); the wrapper serializes
-calls with a module lock anyway so other callers stay safe.
+Thread-safety contract, per wrapper class:
+
+- group/MSM wrappers (verify1, batch_check, reencode, mult_base) hold
+  the module lock because their C functions use static scratch buffers
+  (they are called from the scheduler's single collector thread anyway);
+- the STROBE/merlin/keccak wrappers are deliberately LOCK-FREE and in
+  exchange their C functions must never use static scratch — they touch
+  only the caller's buffers, because gRPC worker threads run them
+  concurrently on distinct transcripts (one per in-flight signature).
 """
 
 from __future__ import annotations

@@ -100,3 +100,28 @@ def test_sign_uses_native_and_stays_verifiable():
     sig2 = R.sign(sk, b"grapevine-challenge", b"m" * 32)
     assert sig1 == sig2
     assert R.verify(pub, b"grapevine-challenge", b"m" * 32, sig1)
+
+
+def test_batch_verify_pippenger_paths():
+    """Batches large enough to cross the Straus→Pippenger dispatch
+    (>64 points → c=6; >=1024 points → c=8). A wrong bucket MSM makes
+    the random-linear-combination equation fail with overwhelming
+    probability, so valid-batch acceptance + corrupted-batch rejection
+    pin the new path against the algebra."""
+    import grapevine_tpu.native as native
+
+    if native.lib is None:
+        pytest.skip("native library unavailable")
+    ctx = b"test-pippenger"
+    for n_sigs in (100, 520):  # 200 points (c=6) and 1040 points (c=8)
+        items = []
+        for i in range(n_sigs):
+            sk, pub = R.keygen(i.to_bytes(4, "little") * 8)
+            msg = i.to_bytes(8, "little")
+            items.append((pub, ctx, msg, R.sign(sk, ctx, msg)))
+        assert R.batch_verify(items), f"valid batch of {n_sigs} rejected"
+        bad = list(items)
+        sig = bytearray(bad[n_sigs // 2][3])
+        sig[1] ^= 0x40
+        bad[n_sigs // 2] = (bad[n_sigs // 2][0], ctx, bad[n_sigs // 2][2], bytes(sig))
+        assert not R.batch_verify(bad), f"corrupted batch of {n_sigs} accepted"

@@ -84,17 +84,7 @@ def main():
 
         chacha._Cipher = None
         merlin._native_strobe = lambda: None
-
-        def _pure_challenge_scalar(context, message, pub, r_enc):
-            t = schnorrkel._context_prefix(bytes(context)).clone()
-            t.append_message(b"sign-bytes", message)
-            t.append_message(b"proto-name", schnorrkel._PROTO)
-            t.append_message(b"sign:pk", pub)
-            t.append_message(b"sign:R", r_enc)
-            wide = t.challenge_bytes(b"sign:c", 64)
-            return int.from_bytes(wide, "little") % schnorrkel._r.L
-
-        schnorrkel._challenge_scalar = _pure_challenge_scalar
+        schnorrkel._challenge_scalar = schnorrkel._challenge_scalar_pure
 
     import jax
 
