@@ -263,15 +263,18 @@ def bench_zipf_pallas(smoke, impl="pallas"):
     """zipf_mixed through a Pallas cipher kernel (``impl="pallas"`` =
     fused VMEM keystream+XOR; ``"pallas_fused"`` = that plus the path
     gather fused into the decrypt, one HBM pass per fetched row).
-    Full-size runs require a backend that compiles Mosaic (named
-    "tpu"); elsewhere the kernel would fall back to interpret mode,
+    Full-size runs require a backend that compiles Mosaic ("tpu", or
+    "axon" — the relay tunnel's name for its one real chip); elsewhere
+    the kernel would fall back to interpret mode,
     which at B=2048 means thousands of per-tile dispatches — skipped
     rather than timed. Smoke mode runs interpret at toy shapes to keep
     the path exercised."""
     import jax
 
+    from grapevine_tpu.testing.compare import TPU_BACKENDS
+
     backend = jax.default_backend()
-    if impl == "pallas_fused" and backend != "tpu":
+    if impl == "pallas_fused" and backend not in TPU_BACKENDS:
         # The fused gather's grid is one step per fetched row, and
         # interpret mode traces every grid step into the jit — ~60 s of
         # tracing at B=2048, so real shapes are Mosaic-only. But the
@@ -280,8 +283,8 @@ def bench_zipf_pallas(smoke, impl="pallas"):
         # round, not only when a TPU shows up: run ONE toy-shape round
         # and report it under a key that cannot be mistaken for perf.
         return _fused_plumbing_proof()
-    if not smoke and backend != "tpu":
-        return {"skipped": f"needs a direct TPU backend for Mosaic (have {backend!r})"}
+    if not smoke and backend not in TPU_BACKENDS:
+        return {"skipped": f"needs a TPU backend for Mosaic (have {backend!r})"}
     return bench_zipf_mixed(smoke, cipher_impl=impl)
 
 

@@ -1,0 +1,45 @@
+"""State-comparison helpers shared by tests and the TPU capture tool."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["states_equal_excluding_junk", "TPU_BACKENDS"]
+
+#: backend names that mean "a real TPU executes the program": the
+#: direct PJRT plugin reports "tpu"; the axon relay tunnel reports
+#: "axon" (BENCH_r02.json tail) while still driving one real chip
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def states_equal_excluding_junk(sa, sb):
+    """Engine-state bit-equality with the padded junk bucket masked.
+
+    The fused encrypt+scatter kernel redirects non-owner duplicate-row
+    writes to the LAST (padded) bucket of each tree, which heap indices
+    never address (oblivious/pallas_gather.py) — so that bucket's
+    at-rest bytes legitimately differ from the jnp path while every
+    path-addressable byte must match exactly. Z is derived per tree
+    from the paired ``tree_idx``/``tree_val`` leaves, never hardcoded.
+
+    Returns (equal, first_differing_keypath_or_None).
+    """
+    if jax.tree_util.tree_structure(sa) != jax.tree_util.tree_structure(sb):
+        return False, "<tree structure>"
+    la = {
+        jax.tree_util.keystr(p): np.asarray(x)
+        for p, x in jax.tree_util.tree_leaves_with_path(sa)
+    }
+    lb = dict(zip(la.keys(), map(np.asarray, jax.tree_util.tree_leaves(sb))))
+    for key, x in la.items():
+        y = lb[key]
+        if key.endswith("tree_val"):
+            x, y = x[:-1], y[:-1]
+        elif key.endswith("tree_idx"):
+            val = la[key[: -len("tree_idx")] + "tree_val"]
+            z = x.size // val.shape[0]
+            x, y = x[:-z], y[:-z]
+        if not np.array_equal(x, y):
+            return False, key
+    return True, None

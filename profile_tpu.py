@@ -37,8 +37,10 @@ def main() -> int:
 
     import jax
 
+    from grapevine_tpu.testing.compare import TPU_BACKENDS
+
     backend = jax.default_backend()
-    if backend != "tpu":
+    if backend not in TPU_BACKENDS:
         print(json.dumps({"error": f"needs a TPU backend, have {backend!r}"}))
         return 1
 

@@ -93,13 +93,21 @@ def _run_crd(impl: str, seed: int = 9):
         NOW + 2,
     )[0]
     outs.append((r3.status_code, r3.record.msg_id, r3.record.payload))
-    return outs
+    return outs, e.state
 
 
 def test_engine_round_identical_across_cipher_impls():
-    """Full engine C-R-D through the fused fetch ≡ the jnp path (same
-    seed ⇒ same ids, payloads, statuses)."""
-    assert _run_crd("pallas_fused") == _run_crd("jnp")
+    """Full engine C-R-D through the fused fetch ≡ the jnp path: same
+    seed ⇒ same ids, payloads, statuses, AND bit-identical state up to
+    the junk bucket (found divergent-by-design in round 5; everything
+    path-addressable must match exactly)."""
+    from grapevine_tpu.testing.compare import states_equal_excluding_junk
+
+    outs_f, state_f = _run_crd("pallas_fused")
+    outs_j, state_j = _run_crd("jnp")
+    assert outs_f == outs_j
+    same, first_diff = states_equal_excluding_junk(state_j, state_f)
+    assert same, f"state diverges at {first_diff}"
 
 
 def test_sharded_path_ignores_fused_fetch():

@@ -1,0 +1,322 @@
+#!/usr/bin/env python3
+"""One-shot TPU evidence capture, ordered by verdict value.
+
+The axon relay flaps across sessions (PROBELOG_r4/r5: dead for whole
+rounds, up in r2) — so when a backend initializes, ONE serialized
+process must harvest everything the round needs before the window
+closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
+
+1. probe       — backend + device kind (proves the window was real)
+2. headline    — zipf_mixed at B=2048 / 2^20: scan-fused throughput +
+                 per-dispatch p99 (THE scoreboard number)
+3. mosaic      — engine round bit-equality jnp vs pallas vs
+                 pallas_fused ON TPU (first real Mosaic compile of all
+                 three kernels)
+4. pallas_perf — zipf_pallas_cipher + zipf_pallas_fused at full size
+5. oblivious   — transcript equality + R/U/D timing z-scores from
+                 TPU-executed rounds (tiny capacity; it is the compiled
+                 schedule being tested, not scale)
+6. trace       — jax.profiler trace of the headline round, to reconcile
+                 PERF.md's ~5-10 ms model
+
+Every stage appends one JSON line to --out (default TPURUN_r5.jsonl,
+repo root) and flushes — a relay death mid-run keeps everything already
+captured. Each stage runs in its OWN subprocess under a hard timeout:
+a wedged device dispatch blocks in C++ where Python signal handlers
+never run, so only a process kill can bound it (and the relay's
+single-claim tunnel is released when the child dies). Heavy work is
+serialized; nothing else should hold the tunnel while this runs.
+
+Run: python tools/tpu_capture.py [--quick] [--skip STAGE,...]
+``--quick`` shrinks the headline/pallas configs (B=256, 2^16) for a
+short relay window; rerun without it if the window holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+class Capture:
+    def __init__(self, out_path):
+        self.out = open(out_path, "a", buffering=1)
+
+    def emit(self, stage, **kv):
+        line = {"stage": stage, "t": round(time.time(), 1), **kv}
+        self.out.write(json.dumps(line) + "\n")
+        self.out.flush()
+        print(json.dumps(line), flush=True)
+
+
+def stage_probe(cap, args):
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.float32)
+    (x @ x).block_until_ready()
+    dev = jax.devices()[0]
+    cap.emit("probe", backend=jax.default_backend(),
+             device_kind=getattr(dev, "device_kind", str(dev)),
+             n_devices=len(jax.devices()),
+             init_s=round(time.perf_counter() - t0, 1))
+    from grapevine_tpu.testing.compare import TPU_BACKENDS
+
+    if jax.default_backend() not in TPU_BACKENDS:
+        raise RuntimeError(f"not a TPU backend: {jax.default_backend()!r}")
+
+
+def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds):
+    """zipf_mixed through a chosen cipher impl at a chosen size, using
+    bench.py's own machinery (same methodology as the driver bench)."""
+    import jax
+    import numpy as np
+
+    import bench
+
+    t0 = time.perf_counter()
+    cfg, ecfg, state, step = bench._mk_engine(
+        1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl
+    )
+    batches = bench.make_batches(4, batch)
+    compile_t0 = time.perf_counter()
+    state, resp, _ = step(ecfg, state, batches[0])
+    jax.block_until_ready(resp)
+    compile_s = time.perf_counter() - compile_t0
+    _, times, total = bench._run_rounds(ecfg, state, step, batches[1:], n_rounds)
+    ops = batch * n_rounds
+    cap.emit(stage_name, impl=impl, capacity_log2=cap_log2, batch=batch,
+             rounds=n_rounds, ops_per_sec=round(ops / total, 1),
+             p99_round_ms=round(bench._p99(times), 2),
+             median_round_ms=round(float(np.median(times)) * 1e3, 3),
+             compile_s=round(compile_s, 1),
+             wall_s=round(time.perf_counter() - t0, 1))
+
+
+def stage_headline(cap, args):
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "headline", "jnp", cl, b, 8)
+
+
+def stage_mosaic(cap, args):
+    """All three kernels Mosaic-compiled on TPU; engine round results +
+    final state bit-identical across cipher impls (cipher ON), junk
+    bucket excluded (see _state_equal_excluding_junk)."""
+    import jax
+    import numpy as np
+
+    import bench
+
+    outs = {}
+    for impl in ("jnp", "pallas", "pallas_fused"):
+        t0 = time.perf_counter()
+        cfg, ecfg, state, step = bench._mk_engine(
+            1 << 10, 1 << 6, 16, cipher_impl=impl
+        )
+        batches = bench.make_batches(3, 16)
+        rs = []
+        for b in batches:
+            state, resp, tr = step(ecfg, state, b)
+            rs.append(resp)
+        jax.block_until_ready(rs[-1])
+        outs[impl] = (
+            [{k: np.asarray(v) for k, v in r.items()} for r in rs],
+            jax.tree_util.tree_map(np.asarray, state),
+        )
+        cap.emit("mosaic_compile", impl=impl,
+                 wall_s=round(time.perf_counter() - t0, 1))
+    ok = True
+    detail = {}
+    for impl in ("pallas", "pallas_fused"):
+        same = all(
+            all(np.array_equal(outs["jnp"][0][i][k], outs[impl][0][i][k])
+                for k in outs["jnp"][0][i])
+            for i in range(len(outs["jnp"][0]))
+        )
+        from grapevine_tpu.testing.compare import states_equal_excluding_junk
+
+        st_same, first_diff = states_equal_excluding_junk(
+            outs["jnp"][1], outs[impl][1])
+        detail[impl] = {"responses_equal": bool(same),
+                        "state_equal_excl_junk_bucket": bool(st_same),
+                        **({"first_diff": first_diff} if first_diff else {})}
+        ok = ok and same and st_same
+    cap.emit("mosaic", bit_identical=ok, detail=detail)
+    if not ok:
+        raise RuntimeError(f"Mosaic kernels diverge from jnp: {detail}")
+
+
+def stage_pallas_perf(cap, args):
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "pallas_perf", "pallas", cl, b, 8)
+    _zipf_run(cap, "pallas_perf", "pallas_fused", cl, b, 8)
+
+
+def stage_oblivious(cap, args):
+    """SURVEY §7 hard-part 2 on the real device: R/U/D transcript
+    equality + timing uniformity, reusing the CPU suite's EXACT
+    methodology (tests/test_round.py's same-message construction,
+    tests/test_timing_uniformity.py's interleaved Mann-Whitney z) so
+    the TPU result is directly comparable to the CI record."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    import test_timing_uniformity as ttu
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.testing.leakcheck import timing_twosample_z
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    # --- transcript equality: R/U/D of the same message, identically
+    # seeded engines (test_round.py::test_round_engine_rud_transcripts)
+    small = GrapevineConfig(max_messages=64, max_recipients=8,
+                            mailbox_cap=4, batch_size=4,
+                            bucket_cipher_rounds=8)
+    a_id, b_id = b"\x07" * 32, b"\x08" * 32
+    now = 1_700_000_000
+
+    def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY):
+        return QueryRequest(
+            request_type=rt, auth_identity=auth,
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(msg_id=msg_id, recipient=recipient))
+
+    def fresh():
+        e = GrapevineEngine(small, seed=11)
+        (r,) = e.handle_queries(
+            [req(C.REQUEST_TYPE_CREATE, a_id, recipient=b_id)], now)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+        return e, r.record.msg_id
+
+    trs = {}
+    for rt in (C.REQUEST_TYPE_READ, C.REQUEST_TYPE_UPDATE,
+               C.REQUEST_TYPE_DELETE):
+        e, mid = fresh()
+        _, tr = e.handle_queries_with_transcript(
+            [req(rt, b_id, msg_id=mid, recipient=b_id)], now + 1)
+        trs[rt] = tr
+    e, mid = fresh()
+    _, tr_unauth = e.handle_queries_with_transcript(
+        [req(C.REQUEST_TYPE_DELETE, b"\x09" * 32, msg_id=mid,
+             recipient=b_id)], now + 1)
+    eq_ru = bool(np.array_equal(trs[C.REQUEST_TYPE_READ],
+                                trs[C.REQUEST_TYPE_UPDATE]))
+    eq_rd = bool(np.array_equal(trs[C.REQUEST_TYPE_READ],
+                                trs[C.REQUEST_TYPE_DELETE]))
+    eq_fail = bool(np.array_equal(trs[C.REQUEST_TYPE_DELETE], tr_unauth))
+
+    # --- timing: the CPU suite's interleaved measurement, on TPU
+    eng, cfg = ttu._mk_engine()
+    ids, recips, sender = ttu._populate(eng, cfg)
+    times = ttu._measure(eng, cfg, ids, recips, sender)
+    z_ru = round(float(timing_twosample_z(times["read"], times["update"])), 2)
+    z_rd = round(float(timing_twosample_z(times["read"], times["delete"])), 2)
+    z_ud = round(float(timing_twosample_z(times["update"], times["delete"])), 2)
+    cap.emit(
+        "oblivious",
+        transcripts_equal={"read_update": eq_ru, "read_delete": eq_rd,
+                           "failed_op_indistinguishable": eq_fail},
+        mean_round_ms={k: round(float(np.mean(v)) * 1e3, 3)
+                       for k, v in times.items()},
+        timing_z={"read_vs_update": z_ru, "read_vs_delete": z_rd,
+                  "update_vs_delete": z_ud},
+        honest_threshold=ttu.HONEST_Z,
+    )
+    if not (eq_ru and eq_rd and eq_fail):
+        raise RuntimeError("transcripts differ across R/U/D on TPU!")
+    if max(abs(z_ru), abs(z_rd), abs(z_ud)) > ttu.HONEST_Z:
+        raise RuntimeError("op-type timing signal detected on TPU!")
+
+
+def stage_trace(cap, args):
+    import jax
+    import numpy as np
+
+    import bench
+
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    outdir = os.path.join(_REPO, "tpu_trace_r5")
+    cfg, ecfg, state, step = bench._mk_engine(1 << cl, 1 << (cl - 8), b)
+    batches = bench.make_batches(4, b)
+    state, resp, _ = step(ecfg, state, batches[0])
+    jax.block_until_ready(resp)
+    times = []
+    with jax.profiler.trace(outdir):
+        for i in range(6):
+            t0 = time.perf_counter()
+            state, resp, _ = step(ecfg, state, batches[i % 4])
+            jax.block_until_ready(resp)
+            times.append(time.perf_counter() - t0)
+    cap.emit("trace", trace_dir=outdir,
+             median_round_ms=round(float(np.median(times)) * 1e3, 3))
+
+
+STAGES = [
+    ("probe", stage_probe, 420),
+    ("headline", stage_headline, 1500),
+    ("mosaic", stage_mosaic, 1200),
+    ("pallas_perf", stage_pallas_perf, 1800),
+    ("oblivious", stage_oblivious, 900),
+    ("trace", stage_trace, 900),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip", default="")
+    ap.add_argument("--out", default=os.path.join(_REPO, "TPURUN_r5.jsonl"))
+    ap.add_argument("--stage", default="",
+                    help="(internal) run ONE stage in this process")
+    args = ap.parse_args()
+
+    cap = Capture(args.out)
+
+    if args.stage:  # child mode: one stage, in-process; parent owns timeout
+        # share compiled programs across stage children where possible
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_r5")
+        fn = dict((n, f) for n, f, _ in STAGES)[args.stage]
+        try:
+            fn(cap, args)
+        except Exception as e:  # noqa: BLE001 — capture-everything harness
+            cap.emit(args.stage, error=f"{type(e).__name__}: {e}")
+            return 1
+        return 0
+
+    cap.emit("start", quick=args.quick, pid=os.getpid())
+    skip = set(args.skip.split(",")) if args.skip else set()
+    failures = 0
+    for name, _fn, cap_s in STAGES:
+        if name in skip:
+            cap.emit(name, skipped=True)
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--stage", name, "--out", args.out]
+        if args.quick:
+            cmd.append("--quick")
+        try:
+            rc = subprocess.run(cmd, timeout=cap_s).returncode
+        except subprocess.TimeoutExpired:
+            cap.emit(name, error=f"stage killed after {cap_s}s "
+                     "(wedged dispatch; child process terminated)")
+            rc = -1
+        if rc != 0:
+            failures += 1
+            if name == "probe":
+                break  # no usable backend — nothing else can run
+    cap.emit("done", failures=failures)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
