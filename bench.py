@@ -271,7 +271,7 @@ def bench_zipf_pallas(smoke, impl="pallas"):
     the path exercised."""
     import jax
 
-    from grapevine_tpu.testing.compare import TPU_BACKENDS
+    from grapevine_tpu.config import TPU_BACKENDS
 
     backend = jax.default_backend()
     if impl == "pallas_fused" and backend not in TPU_BACKENDS:
@@ -614,6 +614,13 @@ def main():
     smoke = "--smoke" in sys.argv
     budget_s = float(os.environ.get("GRAPEVINE_BENCH_BUDGET_S", "1500"))
     per_cfg_s = float(os.environ.get("GRAPEVINE_BENCH_CONFIG_S", "420"))
+    # persistent XLA compilation cache, shared with tools/tpu_capture.py:
+    # full-size TPU compiles cost minutes through the relay's one weak
+    # core; if the probe loop's capture already compiled these programs
+    # during the same session, the driver bench must not pay twice
+    from grapevine_tpu.config import JAX_CACHE_DIR
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
     t_start = time.perf_counter()
     results: dict = {}
     meta: dict = {"sizes": "smoke" if smoke else "full"}

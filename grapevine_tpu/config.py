@@ -19,8 +19,21 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os as _os
 
 from .wire import constants as C
+
+#: backend names that mean "a real TPU executes the program" (Mosaic
+#: compiles, interpret mode off): the direct PJRT plugin reports
+#: "tpu"; the axon relay tunnel reports "axon" (BENCH_r02.json tail)
+#: while still driving one real chip.
+TPU_BACKENDS = ("tpu", "axon")
+
+#: persistent XLA compilation-cache dir shared by bench.py and
+#: tools/tpu_capture.py — full-size TPU compiles cost minutes through
+#: the relay's one weak core, and the capture and the driver bench must
+#: never pay for the same program twice in one session
+JAX_CACHE_DIR = _os.environ.get("GRAPEVINE_JAX_CACHE", "/tmp/jax_cache_r5")
 
 
 @dataclasses.dataclass(frozen=True)

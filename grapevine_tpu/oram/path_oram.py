@@ -53,6 +53,8 @@ import dataclasses
 from typing import Callable, NamedTuple
 
 import jax
+
+from ..config import TPU_BACKENDS as _TPU_BACKENDS
 import jax.numpy as jnp
 
 from ..oblivious.bucket_cipher import epoch_next, row_keystream  # noqa: F401  (row_keystream used by cipher_rows)
@@ -85,7 +87,7 @@ def cipher_rows(
     if cfg.cipher_impl in ("pallas", "pallas_fused"):
         from ..oblivious.pallas_cipher import cipher_rows_pallas
 
-        interpret = jax.default_backend() != "tpu"
+        interpret = jax.default_backend() not in _TPU_BACKENDS
         if interpret and pidx.shape[0] >= 2048:
             # trace-time (once per compile), not per round: interpret
             # mode on a production-size engine means thousands of

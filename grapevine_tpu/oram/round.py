@@ -48,6 +48,8 @@ O(log B)-depth parallel form.
 from __future__ import annotations
 
 import jax
+
+from ..config import TPU_BACKENDS as _TPU_BACKENDS
 import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, rank_of
@@ -162,7 +164,7 @@ def oram_round(
         pidx, pval = gather_decrypt_rows(
             state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
             flat_b, z=z, rounds=cfg.cipher_rounds,
-            interpret=jax.default_backend() != "tpu",
+            interpret=jax.default_backend() not in _TPU_BACKENDS,
         )
     else:
         pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(
@@ -293,7 +295,7 @@ def oram_round(
             new_pidx.reshape(b * plen, z),
             new_pval.reshape(b * plen, z * v),
             z=z, rounds=cfg.cipher_rounds,
-            interpret=jax.default_backend() != "tpu",
+            interpret=jax.default_backend() not in _TPU_BACKENDS,
         )
     else:
         enc_pidx, enc_pval = cipher_rows(

@@ -64,6 +64,26 @@ def build_parser() -> argparse.ArgumentParser:
         "handshake) so clients can pin it across restarts; omitted = "
         "fresh identity per start. The public key is printed either way.",
     )
+    p.add_argument(
+        "--role",
+        choices=["mono", "engine", "frontend"],
+        default="mono",
+        help="mono = engine + sessions in one process (default); "
+        "engine = device engine tier only (serves the internal Submit "
+        "API on --engine-listen); frontend = client-facing session "
+        "process forwarding validated ops to --engine (run N of these "
+        "behind a load balancer — server/tier.py)",
+    )
+    p.add_argument(
+        "--engine-listen",
+        default="127.0.0.1:0",
+        help="(role=engine) internal host:port for the Submit API — "
+        "keep it on localhost or a private interface",
+    )
+    p.add_argument(
+        "--engine",
+        help="(role=frontend) host:port of the engine tier's Submit API",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -87,10 +107,34 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"--identity-seed must be 64 hex chars (32 bytes): {exc}"
             ) from None
-    server = GrapevineServer(
-        config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
-        identity=identity,
-    )
+    if args.role == "engine":
+        import threading
+
+        from .tier import EngineServer
+
+        engine = EngineServer(config, seed=args.seed,
+                              max_wait_ms=args.batch_wait_ms)
+        port = engine.start(args.engine_listen)
+        print(f"grapevine-tpu engine tier listening on port {port}",
+              flush=True)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            engine.stop()
+        return 0
+
+    if args.role == "frontend":
+        if not args.engine:
+            raise SystemExit("--role frontend requires --engine host:port")
+        from .tier import FrontendServer
+
+        server = FrontendServer(args.engine, config=config,
+                                identity=identity)
+    else:
+        server = GrapevineServer(
+            config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
+            identity=identity,
+        )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None
     port = server.start(args.listen, tls_cert=tls_cert, tls_key=tls_key)
@@ -98,7 +142,12 @@ def main(argv=None) -> int:
     # the pinnable IX static (clients: GrapevineClient(server_static=...))
     print(f"server static key: {server.identity.public.hex()}", flush=True)
     try:
-        server.wait()
+        if args.role == "frontend":
+            import threading
+
+            threading.Event().wait()
+        else:
+            server.wait()
     except KeyboardInterrupt:
         server.stop()
     return 0

@@ -70,18 +70,27 @@ class GrapevineServer:
         session_ttl: float = 3600.0,
         max_sessions: int = 4096,
         identity: chan.ServerIdentity | None = None,
+        scheduler=None,
     ):
         self.config = config or GrapevineConfig()
-        self.engine = GrapevineEngine(self.config, seed=seed)
-        sched_kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
-        from ..session import get_signature_scheme
+        if scheduler is not None:
+            # injected op sink (server/tier.py's FrontendServer passes
+            # its engine-tier RPC stub): no in-process device engine
+            self.engine = None
+            self.scheduler = scheduler
+        else:
+            self.engine = GrapevineEngine(self.config, seed=seed)
+            sched_kwargs = (
+                {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
+            )
+            from ..session import get_signature_scheme
 
-        self.scheduler = BatchScheduler(
-            self.engine,
-            clock=clock,
-            scheme=get_signature_scheme(self.config.signature_scheme),
-            **sched_kwargs,
-        )
+            self.scheduler = BatchScheduler(
+                self.engine,
+                clock=clock,
+                scheme=get_signature_scheme(self.config.signature_scheme),
+                **sched_kwargs,
+            )
         self.attestation = attestation or chan.NullAttestation()
         #: IX responder static; ``server.identity.public`` is what
         #: clients pin via ``expected_server_static`` (SECURITY.md)
@@ -229,7 +238,7 @@ class GrapevineServer:
         if port == 0:
             raise RuntimeError(f"failed to bind {uri.address}")
         self._grpc_server.start()
-        if self.config.expiry_period > 0:
+        if self.config.expiry_period > 0 and self.engine is not None:
             self._expiry_thread = threading.Thread(target=self._expiry_loop, daemon=True)
             self._expiry_thread.start()
         log.info("grapevine-tpu serving on %s", uri)
@@ -239,7 +248,8 @@ class GrapevineServer:
         """Aggregate metrics (SURVEY §5: never keyed by client identity)."""
         with self._sessions_lock:
             n_sessions = len(self._sessions)
-        return {"sessions": n_sessions, **self.engine.health()}
+        engine_health = self.engine.health() if self.engine is not None else {}
+        return {"sessions": n_sessions, **engine_health}
 
     def _expiry_loop(self):
         interval = max(1.0, self.config.expiry_period / 10)

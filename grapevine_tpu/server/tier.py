@@ -1,0 +1,229 @@
+"""Split frontend/engine serving tier — the horizontal host-path story.
+
+One CPython process is GIL-bound at ~10k ops/s of session crypto +
+codec work (PERF.md host table), while the device engine targets
+~10-100× that. The reference never faced this split (its frontend was
+C-core gRPC + Rust); here it is explicit: N **frontend** processes
+terminate client sessions (IX handshake, channel AEAD, challenge
+lockstep, request unpack + validation) and forward validated ops to ONE
+**engine** process, which batch-verifies sr25519 signatures ACROSS
+frontends (one Pippenger MSM per round — better batching than any
+frontend could do alone) and runs the oblivious rounds on the device.
+
+Trust model: frontends are deployment-internal (same boundary as the
+reference's untrusted host runtime). The engine accepts pre-decrypted
+requests only from them — bind the engine listener to localhost or a
+private network; client-facing confidentiality still ends at the
+frontends' AEAD channels. The signature check stays in the ENGINE, so a
+compromised frontend cannot forge ops for identities it has never seen
+sign (it can only replay what the session layer already allows — same
+as the reference's host).
+
+Wire (internal, raw-bytes gRPC like the public API):
+    /grapevine.EngineAPI/Submit
+    request  = packed QueryRequest (wire codec, constant size)
+               ‖ challenge (32 B) — the auth identity and signature
+               already travel inside the packed request
+    response = packed QueryResponse, or gRPC UNAUTHENTICATED /
+               INVALID_ARGUMENT mirroring the public service.
+
+The public-facing frontend behaves byte-identically to the monolithic
+``GrapevineServer`` (same Auth/Query surface), so clients need no
+changes and a load balancer can spread them across frontends.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+
+import grpc
+
+from ..config import GrapevineConfig
+from ..wire import constants as C
+from ..wire.records import QueryRequest, QueryResponse
+from .scheduler import AuthFailure
+
+log = logging.getLogger("grapevine_tpu.tier")
+
+ENGINE_SERVICE_NAME = "grapevine.EngineAPI"
+
+_CHALLENGE_SIZE = 32
+
+
+class EngineServer:
+    """The engine tier: one device engine + cross-frontend batching.
+
+    Exposes ``Submit`` (one validated op per RPC). Concurrent RPCs from
+    many frontends land in the shared BatchScheduler, which fills
+    device rounds and batch-verifies each round's signatures with one
+    MSM — exactly the path the monolithic server uses, so every
+    scheduler/engine test covers this tier too.
+    """
+
+    def __init__(self, config: GrapevineConfig | None = None, seed: int = 0,
+                 max_wait_ms: float | None = None, clock=None):
+        from ..engine.batcher import GrapevineEngine
+        from ..session import get_signature_scheme
+        from .scheduler import BatchScheduler
+
+        import time as _time
+
+        self.config = config or GrapevineConfig()
+        self.engine = GrapevineEngine(self.config, seed=seed)
+        kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
+        self.scheduler = BatchScheduler(
+            self.engine,
+            clock=clock,
+            scheme=get_signature_scheme(self.config.signature_scheme),
+            **kwargs,
+        )
+        self._grpc_server: grpc.Server | None = None
+        self.clock = clock or (lambda: int(_time.time()))
+        self._expiry_stop = threading.Event()
+        self._expiry_thread: threading.Thread | None = None
+
+    def _submit(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
+        if len(request_bytes) != C.QUERY_REQUEST_WIRE_SIZE + _CHALLENGE_SIZE:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "bad submit size")
+        challenge = request_bytes[C.QUERY_REQUEST_WIRE_SIZE:]
+        from ..engine.batcher import validate_request
+        from ..testing.reference import HardProtocolError
+
+        try:
+            req = QueryRequest.unpack(request_bytes[: C.QUERY_REQUEST_WIRE_SIZE])
+            validate_request(req)
+        except (ValueError, HardProtocolError) as exc:
+            # same exception scope as the public service's fail-fast —
+            # anything else is an engine bug and must crash loudly, not
+            # masquerade as malformed client traffic
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        try:
+            resp: QueryResponse = self.scheduler.submit(
+                req,
+                auth=(
+                    req.auth_identity,
+                    C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
+                    challenge,
+                    req.auth_signature,
+                ),
+            )
+        except AuthFailure:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "bad challenge signature")
+        return resp.pack()
+
+    def start(self, address: str = "127.0.0.1:0") -> int:
+        """Bind the internal listener (plain host:port — deployment-
+        internal; keep it on localhost or a private interface)."""
+        identity = lambda b: b  # noqa: E731
+        handler = grpc.method_handlers_generic_handler(
+            ENGINE_SERVICE_NAME,
+            {"Submit": grpc.unary_unary_rpc_method_handler(
+                self._submit, request_deserializer=identity,
+                response_serializer=identity)},
+        )
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max(8, 2 * self.config.batch_size))
+        )
+        self._grpc_server.add_generic_rpc_handlers((handler,))
+        port = self._grpc_server.add_insecure_port(address)
+        if port == 0:
+            raise RuntimeError(f"failed to bind engine listener {address}")
+        self._grpc_server.start()
+        if self.config.expiry_period > 0:
+            # the engine tier owns the device, so it owns the sweep
+            def _loop():
+                interval = max(1.0, self.config.expiry_period / 10)
+                while not self._expiry_stop.wait(interval):
+                    evicted = self.engine.expire(self.clock())
+                    if evicted:
+                        log.info("expiry sweep evicted %d records", evicted)
+
+            self._expiry_thread = threading.Thread(target=_loop, daemon=True)
+            self._expiry_thread.start()
+        log.info("engine tier serving on %s", address)
+        return port
+
+    def health(self) -> dict:
+        return self.engine.health()
+
+    def stop(self, grace: float = 1.0):
+        self._expiry_stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace).wait()
+        self.scheduler.close()
+
+
+class _EngineStub:
+    """Scheduler-shaped adapter over the engine tier's Submit RPC, so
+    the frontend can reuse GrapevineServer._query verbatim."""
+
+    def __init__(self, address: str):
+        self._grpc = grpc.insecure_channel(address)
+        identity = lambda b: b  # noqa: E731
+        self._submit = self._grpc.unary_unary(
+            f"/{ENGINE_SERVICE_NAME}/Submit",
+            request_serializer=identity, response_deserializer=identity,
+        )
+
+    def submit(self, req: QueryRequest, auth=None) -> QueryResponse:
+        challenge = auth[2] if auth else b"\x00" * _CHALLENGE_SIZE
+        try:
+            data = self._submit(req.pack() + challenge)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNAUTHENTICATED:
+                raise AuthFailure(str(e.details())) from None
+            raise
+        return QueryResponse.unpack(data)
+
+    def close(self):
+        self._grpc.close()
+
+
+class FrontendServer:
+    """A client-facing session-termination process.
+
+    Byte-identical public surface to the monolithic ``GrapevineServer``
+    (Auth + Query, IX handshake, AEAD, lockstep, validation) — but ops
+    go to a shared engine tier instead of an in-process engine. Run N
+    of these behind a load balancer; each is one CPython process of
+    session crypto, and the engine batches across all of them.
+    """
+
+    def __init__(self, engine_address: str, config: GrapevineConfig | None = None,
+                 attestation=None, clock=None, session_ttl: float = 3600.0,
+                 max_sessions: int = 4096, identity=None):
+        from .service import GrapevineServer
+
+        # The monolithic server with its scheduler swapped for the
+        # engine-tier RPC stub (GrapevineServer's injected-scheduler
+        # mode): every session/auth behavior and its tests carry over
+        # unchanged, and there is no device engine in this process.
+        self._inner = GrapevineServer(
+            config=config,
+            attestation=attestation,
+            clock=clock,
+            session_ttl=session_ttl,
+            max_sessions=max_sessions,
+            identity=identity,
+            scheduler=_EngineStub(engine_address),
+        )
+
+    def start(self, listen_uri, tls_cert: bytes | None = None,
+              tls_key: bytes | None = None) -> int:
+        # expiry sweeps run in the ENGINE process; never start one here
+        # (GrapevineServer.start already skips them when engine is None)
+        return self._inner.start(listen_uri, tls_cert, tls_key)
+
+    @property
+    def identity(self):
+        return self._inner.identity
+
+    def health(self) -> dict:
+        return self._inner.health()
+
+    def stop(self, grace: float = 1.0):
+        self._inner.stop(grace)

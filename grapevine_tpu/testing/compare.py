@@ -5,12 +5,9 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-__all__ = ["states_equal_excluding_junk", "TPU_BACKENDS"]
+from ..config import TPU_BACKENDS
 
-#: backend names that mean "a real TPU executes the program": the
-#: direct PJRT plugin reports "tpu"; the axon relay tunnel reports
-#: "axon" (BENCH_r02.json tail) while still driving one real chip
-TPU_BACKENDS = ("tpu", "axon")
+__all__ = ["states_equal_excluding_junk", "TPU_BACKENDS"]
 
 
 def states_equal_excluding_junk(sa, sb):

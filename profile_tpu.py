@@ -37,7 +37,7 @@ def main() -> int:
 
     import jax
 
-    from grapevine_tpu.testing.compare import TPU_BACKENDS
+    from grapevine_tpu.config import TPU_BACKENDS
 
     backend = jax.default_backend()
     if backend not in TPU_BACKENDS:

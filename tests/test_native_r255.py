@@ -125,3 +125,27 @@ def test_batch_verify_pippenger_paths():
         sig[1] ^= 0x40
         bad[n_sigs // 2] = (bad[n_sigs // 2][0], ctx, bad[n_sigs // 2][2], bytes(sig))
         assert not R.batch_verify(bad), f"corrupted batch of {n_sigs} accepted"
+
+
+def test_pub_decode_cache_transparent():
+    """The C decoded-public-key cache must be semantically invisible:
+    same pub verifying twice (hit path), a bad signature under a cached
+    pub still rejected, and an invalid encoding rejected repeatedly
+    (never cached)."""
+    import grapevine_tpu.native as native
+
+    if native.lib is None:
+        pytest.skip("native library unavailable")
+    sk, pub = R.keygen(b"\x21" * 32)
+    ctx, msg = b"cache-test", b"m" * 16
+    sig = R.sign(sk, ctx, msg)
+    assert R.verify(pub, ctx, msg, sig)      # cold: caches pub
+    assert R.verify(pub, ctx, msg, sig)      # hit: same result
+    bad = bytearray(sig)
+    bad[3] ^= 1
+    assert not R.verify(pub, ctx, msg, bytes(bad))  # hit + bad sig
+    # invalid encoding: rejected every time, never enters the cache
+    non_canonical = b"\xff" * 32
+    for _ in range(3):
+        assert not R.verify(non_canonical, ctx, msg, sig)
+    assert R.verify(pub, ctx, msg, sig)      # cache still coherent

@@ -39,17 +39,22 @@ EOF
     # only the size-independent stages the quick pass actually captured
     # (checked in the artifact, not assumed).
     cd /root/repo
+    # scope the skip decision to THIS window's lines: the artifact is
+    # append-only across windows, and a passing stage from an earlier
+    # window (possibly older code) must not suppress a re-run
+    n0=$(wc -l < "$OUT" 2>/dev/null || echo 0)
     timeout 7200 python tools/tpu_capture.py --quick \
       >> /tmp/tpu_capture_quick.log 2>&1
     echo "- $ts: quick capture rc=$? (TPURUN_r5.jsonl)" >> "$LOG"
+    fresh=$(tail -n +$((n0 + 1)) "$OUT" 2>/dev/null)
     skip=""
-    grep -q '"stage": "mosaic".*"bit_identical": true' "$OUT" 2>/dev/null \
+    echo "$fresh" | grep -q '"stage": "mosaic".*"bit_identical": true' \
       && skip="mosaic"
     # success = measurement line present AND no error line: the stage
     # emits its measurements BEFORE raising on a failed invariant, and
     # the raise adds a separate {"stage": "oblivious", ... "error"} line
-    if grep -q '"stage": "oblivious".*"transcripts_equal"' "$OUT" 2>/dev/null \
-      && ! grep -q '"stage": "oblivious".*"error"' "$OUT" 2>/dev/null; then
+    if echo "$fresh" | grep -q '"stage": "oblivious".*"transcripts_equal"' \
+      && ! echo "$fresh" | grep -q '"stage": "oblivious".*"error"'; then
       skip="${skip:+$skip,}oblivious"
     fi
     timeout 7200 python tools/tpu_capture.py ${skip:+--skip "$skip"} \

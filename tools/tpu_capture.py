@@ -68,7 +68,7 @@ def stage_probe(cap, args):
              device_kind=getattr(dev, "device_kind", str(dev)),
              n_devices=len(jax.devices()),
              init_s=round(time.perf_counter() - t0, 1))
-    from grapevine_tpu.testing.compare import TPU_BACKENDS
+    from grapevine_tpu.config import TPU_BACKENDS
 
     if jax.default_backend() not in TPU_BACKENDS:
         raise RuntimeError(f"not a TPU backend: {jax.default_backend()!r}")
@@ -284,7 +284,9 @@ def main():
 
     if args.stage:  # child mode: one stage, in-process; parent owns timeout
         # share compiled programs across stage children where possible
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_r5")
+        from grapevine_tpu.config import JAX_CACHE_DIR
+
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE_DIR)
         fn = dict((n, f) for n, f, _ in STAGES)[args.stage]
         try:
             fn(cap, args)
