@@ -108,6 +108,25 @@ def main(argv=None) -> int:
                 f"--identity-seed must be 64 hex chars (32 bytes): {exc}"
             ) from None
     if args.role == "engine":
+        # the engine tier serves the PRE-DECRYPTED internal Submit API:
+        # client-facing flags do not apply, and silently dropping them
+        # would hide a misconfiguration (e.g. expecting TLS or a pinned
+        # identity on this listener) — fail loudly instead
+        ignored = [
+            name for name, val in (
+                ("--tls-cert", args.tls_cert), ("--tls-key", args.tls_key),
+                ("--identity-seed", args.identity_seed),
+            ) if val
+        ]
+        if args.listen != build_parser().get_default("listen"):
+            ignored.append("--listen")
+        if ignored:
+            raise SystemExit(
+                f"--role engine does not take {', '.join(ignored)}: the "
+                "internal Submit API is plaintext and session-free (run "
+                "frontends for the client-facing surface; bind "
+                "--engine-listen to localhost or a private interface)"
+            )
         import threading
 
         from .tier import EngineServer
@@ -142,12 +161,7 @@ def main(argv=None) -> int:
     # the pinnable IX static (clients: GrapevineClient(server_static=...))
     print(f"server static key: {server.identity.public.hex()}", flush=True)
     try:
-        if args.role == "frontend":
-            import threading
-
-            threading.Event().wait()
-        else:
-            server.wait()
+        server.wait()
     except KeyboardInterrupt:
         server.stop()
     return 0

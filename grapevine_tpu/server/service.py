@@ -46,6 +46,20 @@ from .uri import SERVICE_NAME  # noqa: E402  (re-export, see uri.py)
 SESSION_TOKEN_SIZE = 16
 
 
+def run_expiry_loop(engine, config, stop_event, clock, health=None):
+    """The expiry-sweep loop, shared by the monolithic server and the
+    engine tier (server/tier.py) — whoever owns the device owns this."""
+    interval = max(1.0, config.expiry_period / 10)
+    while not stop_event.wait(interval):
+        evicted = engine.expire(clock())
+        if evicted:
+            log.info("expiry sweep evicted %d records", evicted)
+        # health() syncs the device (stash sampling) — only pay that
+        # when someone is listening at DEBUG
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("health %s", (health or engine.health)())
+
+
 class _Session:
     __slots__ = ("channel", "challenge_rng", "created", "last_used", "lock")
 
@@ -252,15 +266,8 @@ class GrapevineServer:
         return {"sessions": n_sessions, **engine_health}
 
     def _expiry_loop(self):
-        interval = max(1.0, self.config.expiry_period / 10)
-        while not self._expiry_stop.wait(interval):
-            evicted = self.engine.expire(self.clock())
-            if evicted:
-                log.info("expiry sweep evicted %d records", evicted)
-            # health() syncs the device (stash sampling) — only pay that
-            # when someone is listening at DEBUG
-            if log.isEnabledFor(logging.DEBUG):
-                log.debug("health %s", self.health())
+        run_expiry_loop(self.engine, self.config, self._expiry_stop,
+                        self.clock, health=self.health)
 
     def stop(self, grace: float = 1.0):
         self._expiry_stop.set()

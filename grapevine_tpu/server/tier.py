@@ -41,6 +41,8 @@ from concurrent import futures
 import grpc
 
 from ..config import GrapevineConfig
+from ..engine.batcher import validate_request
+from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire.records import QueryRequest, QueryResponse
 from .scheduler import AuthFailure
@@ -48,8 +50,6 @@ from .scheduler import AuthFailure
 log = logging.getLogger("grapevine_tpu.tier")
 
 ENGINE_SERVICE_NAME = "grapevine.EngineAPI"
-
-_CHALLENGE_SIZE = 32
 
 
 class EngineServer:
@@ -85,12 +85,9 @@ class EngineServer:
         self._expiry_thread: threading.Thread | None = None
 
     def _submit(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
-        if len(request_bytes) != C.QUERY_REQUEST_WIRE_SIZE + _CHALLENGE_SIZE:
+        if len(request_bytes) != C.QUERY_REQUEST_WIRE_SIZE + C.CHALLENGE_SIZE:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "bad submit size")
         challenge = request_bytes[C.QUERY_REQUEST_WIRE_SIZE:]
-        from ..engine.batcher import validate_request
-        from ..testing.reference import HardProtocolError
-
         try:
             req = QueryRequest.unpack(request_bytes[: C.QUERY_REQUEST_WIRE_SIZE])
             validate_request(req)
@@ -134,15 +131,15 @@ class EngineServer:
             raise RuntimeError(f"failed to bind engine listener {address}")
         self._grpc_server.start()
         if self.config.expiry_period > 0:
-            # the engine tier owns the device, so it owns the sweep
-            def _loop():
-                interval = max(1.0, self.config.expiry_period / 10)
-                while not self._expiry_stop.wait(interval):
-                    evicted = self.engine.expire(self.clock())
-                    if evicted:
-                        log.info("expiry sweep evicted %d records", evicted)
+            # the engine tier owns the device, so it owns the sweep —
+            # the same loop the monolithic server runs (service.py)
+            from .service import run_expiry_loop
 
-            self._expiry_thread = threading.Thread(target=_loop, daemon=True)
+            self._expiry_thread = threading.Thread(
+                target=run_expiry_loop,
+                args=(self.engine, self.config, self._expiry_stop, self.clock),
+                daemon=True,
+            )
             self._expiry_thread.start()
         log.info("engine tier serving on %s", address)
         return port
@@ -170,7 +167,7 @@ class _EngineStub:
         )
 
     def submit(self, req: QueryRequest, auth=None) -> QueryResponse:
-        challenge = auth[2] if auth else b"\x00" * _CHALLENGE_SIZE
+        challenge = auth[2] if auth else b"\x00" * C.CHALLENGE_SIZE
         try:
             data = self._submit(req.pack() + challenge)
         except grpc.RpcError as e:
@@ -224,6 +221,9 @@ class FrontendServer:
 
     def health(self) -> dict:
         return self._inner.health()
+
+    def wait(self):
+        self._inner.wait()
 
     def stop(self, grace: float = 1.0):
         self._inner.stop(grace)

@@ -138,3 +138,41 @@ def test_rounds_batch_across_frontends(tier):
     n_ops = n_each * len(clients)
     rounds = eng.metrics.snapshot()["rounds"] - rounds0
     assert 0 < rounds < n_ops, (rounds, n_ops)
+
+
+def test_engine_submit_fuzz_fail_closed(tier):
+    """Random and mutated submissions to the internal API must fail
+    closed (INVALID_ARGUMENT / UNAUTHENTICATED), never crash the engine
+    tier or commit an op."""
+    import os
+    import random
+
+    eng = tier["engine"].engine
+    msgs0 = eng.message_count()
+    chan = grpc.insecure_channel(f"127.0.0.1:{tier['eport']}")
+    identity = lambda b: b  # noqa: E731
+    submit = chan.unary_unary(
+        f"/{ENGINE_SERVICE_NAME}/Submit",
+        request_serializer=identity, response_deserializer=identity,
+    )
+    rng = random.Random(99)
+    right_size = C.QUERY_REQUEST_WIRE_SIZE + 32
+    for i in range(40):
+        kind = rng.randrange(3)
+        if kind == 0:  # random bytes, random length
+            data = os.urandom(rng.randrange(0, right_size * 2))
+        elif kind == 1:  # right length, random content (bad sig/type)
+            data = os.urandom(right_size)
+        else:  # right length, zeroed (invalid request type)
+            data = bytes(right_size)
+        try:
+            submit(data)
+        except grpc.RpcError as e:
+            assert e.code() in (
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.StatusCode.UNAUTHENTICATED,
+            ), (i, e.code())
+        else:  # pragma: no cover - would mean a forged op committed
+            raise AssertionError(f"fuzz case {i} was accepted")
+    assert eng.message_count() == msgs0  # nothing committed
+    chan.close()
