@@ -230,8 +230,16 @@ def bench_zipf_mixed(smoke, cipher_impl="jnp"):
     mailboxes into the 62-message cap. ``cipher_impl="pallas"`` runs
     the same workload through the fused VMEM keystream kernel
     (oblivious/pallas_cipher.py) — reported as its own config line so
-    a Mosaic compile issue cannot sink the headline."""
-    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, 2048, 12)
+    a Mosaic compile issue cannot sink the headline.
+
+    ``GRAPEVINE_BENCH_BATCH`` overrides the full-size batch (default
+    2048 to bound driver compile time on one weak core; B=4096 runs
+    overflow-free with the batch-scaled stash — PERF.md lever 5 — and
+    halves the per-op share of fixed round cost on a healthy TPU)."""
+    import os
+
+    full_batch = int(os.environ.get("GRAPEVINE_BENCH_BATCH", "2048"))
+    cap, batch, n_rounds = (1 << 10, 8, 4) if smoke else (1 << 20, full_batch, 12)
     cfg, ecfg, state, step = _mk_engine(cap, 1 << 12, batch, cipher_impl=cipher_impl)
     rng = np.random.default_rng(11)
     n_id = 512

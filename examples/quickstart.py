@@ -105,5 +105,47 @@ def main():
     print("done")
 
 
+def main_tier():
+    """The same workflow over the split serving tier (`--tier`):
+    one engine process-equivalent + two frontends, Alice and Bob on
+    DIFFERENT frontends, one shared oblivious bus (server/tier.py)."""
+    from grapevine_tpu.server.tier import EngineServer, FrontendServer
+
+    cfg = GrapevineConfig(max_messages=1 << 10, max_recipients=256, batch_size=8)
+    engine = EngineServer(cfg)
+    eport = engine.start("127.0.0.1:0")
+    fe1 = FrontendServer(f"127.0.0.1:{eport}", config=cfg)
+    fe2 = FrontendServer(f"127.0.0.1:{eport}", config=cfg)
+    p1 = fe1.start("insecure-grapevine://127.0.0.1:0")
+    p2 = fe2.start("insecure-grapevine://127.0.0.1:0")
+    print(f"engine tier on :{eport}; frontends on :{p1} and :{p2}")
+
+    alice = GrapevineClient(
+        f"insecure-grapevine://127.0.0.1:{p1}", identity_seed=b"A" * 32,
+        server_static=fe1.identity.public,
+    )
+    bob = GrapevineClient(
+        f"insecure-grapevine://127.0.0.1:{p2}", identity_seed=b"B" * 32,
+        server_static=fe2.identity.public,
+    )
+    alice.auth()
+    bob.auth()
+    payload = b"hello across the tier".ljust(C.PAYLOAD_SIZE, b"\x00")
+    r = alice.create(recipient=bob.public_key, payload=payload)
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    r = bob.read()
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    print(f"bob (frontend 2) read alice's (frontend 1) message: "
+          f"{r.record.payload.rstrip(chr(0).encode())!r}")
+    bob.delete()
+    fe1.stop()
+    fe2.stop()
+    engine.stop()
+    print("tier demo done")
+
+
 if __name__ == "__main__":
-    main()
+    if "--tier" in sys.argv:
+        main_tier()
+    else:
+        main()

@@ -88,8 +88,42 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+#: which flags each role actually consumes — a flag explicitly supplied
+#: outside its role's set is a misconfiguration, and silently dropping
+#: it would hide exactly the kind of mistake (expecting TLS or a pinned
+#: identity on the wrong listener) that must fail loudly
+_ROLE_FLAGS = {
+    "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
+             "msg_capacity", "recipient_capacity", "batch_size",
+             "batch_wait_ms", "seed", "identity_seed", "verbose", "role"},
+    "engine": {"engine_listen", "expiry_period", "msg_capacity",
+               "recipient_capacity", "batch_size", "batch_wait_ms",
+               "seed", "verbose", "role"},
+    "frontend": {"engine", "listen", "tls_cert", "tls_key",
+                 "batch_size", "identity_seed", "verbose", "role"},
+}
+
+
+def _reject_misapplied_flags(parser, args):
+    allowed = _ROLE_FLAGS[args.role]
+    bad = [
+        f"--{dest.replace('_', '-')}"
+        for dest, val in vars(args).items()
+        if dest not in allowed and val != parser.get_default(dest)
+    ]
+    if bad:
+        raise SystemExit(
+            f"--role {args.role} does not take {', '.join(sorted(bad))} "
+            "(engine = internal plaintext Submit API only; frontend = "
+            "client-facing sessions forwarding to --engine; see "
+            "server/tier.py)"
+        )
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    _reject_misapplied_flags(parser, args)
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     config = GrapevineConfig(
         max_messages=args.msg_capacity,
@@ -108,25 +142,6 @@ def main(argv=None) -> int:
                 f"--identity-seed must be 64 hex chars (32 bytes): {exc}"
             ) from None
     if args.role == "engine":
-        # the engine tier serves the PRE-DECRYPTED internal Submit API:
-        # client-facing flags do not apply, and silently dropping them
-        # would hide a misconfiguration (e.g. expecting TLS or a pinned
-        # identity on this listener) — fail loudly instead
-        ignored = [
-            name for name, val in (
-                ("--tls-cert", args.tls_cert), ("--tls-key", args.tls_key),
-                ("--identity-seed", args.identity_seed),
-            ) if val
-        ]
-        if args.listen != build_parser().get_default("listen"):
-            ignored.append("--listen")
-        if ignored:
-            raise SystemExit(
-                f"--role engine does not take {', '.join(ignored)}: the "
-                "internal Submit API is plaintext and session-free (run "
-                "frontends for the client-facing surface; bind "
-                "--engine-listen to localhost or a private interface)"
-            )
         import threading
 
         from .tier import EngineServer

@@ -156,7 +156,7 @@ def test_engine_submit_fuzz_fail_closed(tier):
         request_serializer=identity, response_deserializer=identity,
     )
     rng = random.Random(99)
-    right_size = C.QUERY_REQUEST_WIRE_SIZE + 32
+    right_size = C.QUERY_REQUEST_WIRE_SIZE + C.CHALLENGE_SIZE
     for i in range(40):
         kind = rng.randrange(3)
         if kind == 0:  # random bytes, random length
@@ -166,7 +166,7 @@ def test_engine_submit_fuzz_fail_closed(tier):
         else:  # right length, zeroed (invalid request type)
             data = bytes(right_size)
         try:
-            submit(data)
+            submit(data, timeout=10)  # a hang must fail, not wedge pytest
         except grpc.RpcError as e:
             assert e.code() in (
                 grpc.StatusCode.INVALID_ARGUMENT,
