@@ -137,7 +137,8 @@ def main_tier():
     assert r.status_code == C.STATUS_CODE_SUCCESS
     print(f"bob (frontend 2) read alice's (frontend 1) message: "
           f"{r.record.payload.rstrip(chr(0).encode())!r}")
-    bob.delete()
+    r = bob.delete()
+    assert r.status_code == C.STATUS_CODE_SUCCESS
     fe1.stop()
     fe2.stop()
     engine.stop()

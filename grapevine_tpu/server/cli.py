@@ -6,6 +6,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import sys
 
 
 def _pin_platform() -> None:
@@ -28,9 +29,13 @@ from .service import GrapevineServer  # noqa: E402
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # allow_abbrev=False: role/flag validation detects explicitly-
+    # supplied options by exact token match in argv, which abbreviated
+    # option prefixes would dodge
     p = argparse.ArgumentParser(
         prog="grapevine-server",
         description="TPU-native oblivious message bus server",
+        allow_abbrev=False,
     )
     p.add_argument(
         "--listen",
@@ -104,12 +109,26 @@ _ROLE_FLAGS = {
 }
 
 
-def _reject_misapplied_flags(parser, args):
+def _reject_misapplied_flags(parser, args, argv):
     allowed = _ROLE_FLAGS[args.role]
+    # presence = the option token actually appears in argv (exact match
+    # or --opt=value form; abbreviations are disabled on the parser), so
+    # even a misapplied flag supplied WITH its default value fails loudly
+    supplied = set()
+    tokens = list(argv if argv is not None else sys.argv[1:])
+    for action in parser._actions:
+        for opt in action.option_strings:
+            if any(t == opt or t.startswith(opt + "=") for t in tokens):
+                supplied.add(action.dest)
+    # every parser dest must be claimed by some role — catches a flag
+    # added to build_parser but missed in the matrix at dev time
+    dests = {a.dest for a in parser._actions if a.dest != "help"}
+    unclaimed = dests - set().union(*_ROLE_FLAGS.values())
+    assert not unclaimed, f"flags missing from _ROLE_FLAGS: {unclaimed}"
     bad = [
         f"--{dest.replace('_', '-')}"
-        for dest, val in vars(args).items()
-        if dest not in allowed and val != parser.get_default(dest)
+        for dest in supplied
+        if dest not in allowed
     ]
     if bad:
         raise SystemExit(
@@ -123,7 +142,7 @@ def _reject_misapplied_flags(parser, args):
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    _reject_misapplied_flags(parser, args)
+    _reject_misapplied_flags(parser, args, argv)
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     config = GrapevineConfig(
         max_messages=args.msg_capacity,

@@ -176,3 +176,35 @@ def test_engine_submit_fuzz_fail_closed(tier):
             raise AssertionError(f"fuzz case {i} was accepted")
     assert eng.message_count() == msgs0  # nothing committed
     chan.close()
+
+
+def test_engine_tier_runs_expiry_sweep():
+    """The engine tier owns the device, so it owns the expiry sweep
+    (the same run_expiry_loop the monolithic server uses)."""
+    import time
+
+    cfg = GrapevineConfig(
+        max_messages=64, max_recipients=16, batch_size=4,
+        bucket_cipher_rounds=0, expiry_period=10,
+    )
+    now = [1_700_000_000]
+    engine = EngineServer(cfg, seed=9, clock=lambda: now[0])
+    eport = engine.start("127.0.0.1:0")
+    fe = FrontendServer(f"127.0.0.1:{eport}", config=cfg)
+    port = fe.start("insecure-grapevine://127.0.0.1:0")
+    try:
+        c = GrapevineClient(
+            f"insecure-grapevine://127.0.0.1:{port}", identity_seed=b"\x77" * 32
+        )
+        c.auth()
+        r = c.create(c.public_key, b"\x05" * C.PAYLOAD_SIZE)
+        assert r.status_code == C.STATUS_CODE_SUCCESS
+        assert engine.engine.message_count() == 1
+        now[0] += 1000  # all records now older than the period
+        deadline = time.time() + 15  # sweep interval = period/10 = 1 s
+        while engine.engine.message_count() and time.time() < deadline:
+            time.sleep(0.25)
+        assert engine.engine.message_count() == 0, "sweep never evicted"
+    finally:
+        fe.stop()
+        engine.stop()
