@@ -621,7 +621,8 @@ def main():
 
     smoke = "--smoke" in sys.argv
     budget_s = float(os.environ.get("GRAPEVINE_BENCH_BUDGET_S", "1500"))
-    per_cfg_s = float(os.environ.get("GRAPEVINE_BENCH_CONFIG_S", "420"))
+    per_cfg_env = os.environ.get("GRAPEVINE_BENCH_CONFIG_S")
+    per_cfg_s = float(per_cfg_env) if per_cfg_env else 420.0
     # persistent XLA compilation cache, shared with tools/tpu_capture.py:
     # full-size TPU compiles cost minutes through the relay's one weak
     # core; if the probe loop's capture already compiled these programs
@@ -665,6 +666,14 @@ def main():
                   file=sys.stderr, flush=True)
         else:
             meta["backend"] = backend
+            from grapevine_tpu.config import TPU_BACKENDS
+
+            if backend in TPU_BACKENDS and not per_cfg_env:
+                # cold full-size compiles through the relay's one weak
+                # core can alone approach the CPU-tuned 420s cap; with a
+                # real device the headline-first ordering makes a longer
+                # leash the right trade (explicit env still wins)
+                per_cfg_s = 900.0
     _emit(results, meta)
     for name, fn in CONFIGS:
         elapsed = time.perf_counter() - t_start

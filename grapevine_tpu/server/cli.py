@@ -124,7 +124,8 @@ def _reject_misapplied_flags(parser, args, argv):
     # added to build_parser but missed in the matrix at dev time
     dests = {a.dest for a in parser._actions if a.dest != "help"}
     unclaimed = dests - set().union(*_ROLE_FLAGS.values())
-    assert not unclaimed, f"flags missing from _ROLE_FLAGS: {unclaimed}"
+    if unclaimed:  # not assert: must survive python -O
+        raise SystemExit(f"flags missing from _ROLE_FLAGS: {unclaimed}")
     bad = [
         f"--{dest.replace('_', '-')}"
         for dest in supplied

@@ -1,0 +1,59 @@
+"""Role/flag validation of the server CLI (server/cli.py): misapplied
+flags fail loudly by argv-token presence, even at default values."""
+
+from __future__ import annotations
+
+import pytest
+
+from grapevine_tpu.server import cli
+
+
+def _check(argv):
+    parser = cli.build_parser()
+    args = parser.parse_args(argv)
+    cli._reject_misapplied_flags(parser, args, argv)
+    return args
+
+
+@pytest.mark.parametrize("argv", [
+    ["--role", "engine", "--identity-seed", "ab" * 32],
+    ["--role", "engine", "--tls-cert", "c.pem"],
+    # explicitly supplied WITH the default value still rejects
+    ["--role", "engine", "--listen", "insecure-grapevine://0.0.0.0:3229"],
+    ["--role", "frontend", "--seed", "0"],
+    ["--role", "frontend", "--expiry-period", "60"],
+    ["--role", "mono", "--engine", "x:1"],
+    ["--role", "mono", "--engine-listen", "127.0.0.1:0"],
+])
+def test_misapplied_flags_rejected(argv):
+    with pytest.raises(SystemExit, match="does not take"):
+        _check(argv)
+
+
+@pytest.mark.parametrize("argv", [
+    [],
+    ["--role", "mono", "--listen", "insecure-grapevine://0.0.0.0:1",
+     "--identity-seed", "ab" * 32, "--expiry-period", "60"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--msg-capacity", "512", "--batch-size", "16", "--seed", "3"],
+    ["--role", "frontend", "--engine", "127.0.0.1:4000",
+     "--listen", "insecure-grapevine://0.0.0.0:1", "--batch-size", "16"],
+])
+def test_valid_role_flag_combinations_accepted(argv):
+    _check(argv)  # must not raise
+
+
+def test_abbreviated_options_rejected():
+    """allow_abbrev=False: the presence scan matches exact tokens, so
+    abbreviations must not parse at all."""
+    with pytest.raises(SystemExit):
+        cli.build_parser().parse_args(["--rol", "engine"])
+
+
+def test_unclaimed_parser_flag_fails_loudly(monkeypatch):
+    """A flag added to build_parser but missing from every role's set
+    must error at validation time (and not via a strippable assert)."""
+    trimmed = {k: v - {"seed"} for k, v in cli._ROLE_FLAGS.items()}
+    monkeypatch.setattr(cli, "_ROLE_FLAGS", trimmed)
+    with pytest.raises(SystemExit, match="missing from _ROLE_FLAGS"):
+        _check([])
