@@ -1,0 +1,127 @@
+"""Engine round-cost scaling study: executed evidence for the SHAPE of
+PERF.md's cost model (per-round work ~ B·path_len rows of gather/
+scatter + cipher + eviction sort), on whatever backend is available.
+
+The absolute numbers on a CPU backend say nothing about TPU throughput;
+the SCALING — how round time moves with batch size B and capacity N —
+transfers, because it is a property of the program's operation counts,
+not the backend's speed. The model predicts:
+
+- round time ≈ fixed + c·B·log2(N): linear in B at fixed N, and the
+  per-op cost B·plen/B = plen grows only logarithmically with N;
+- ops/s therefore RISES with B until HBM/FLOP saturation (amortizing
+  the fixed round overhead) — the whole premise of batched rounds.
+
+Run:  python tools/scaling_study.py [--out SCALING.md]
+Writes a markdown table + least-squares fit. Uses scan-fused rounds
+(bench.py's throughput methodology) with the cipher ON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def measure(cap_log2: int, batch: int, n_rounds: int = 8):
+    import jax
+
+    import bench
+
+    cfg, ecfg, state, step = bench._mk_engine(
+        1 << cap_log2, 1 << max(8, cap_log2 - 8), batch,
+        cipher_impl="jnp",
+    )
+    batches = bench.make_batches(4, batch)
+    t0 = time.perf_counter()
+    state, resp, _ = step(ecfg, state, batches[0])
+    jax.block_until_ready(resp)
+    compile_s = time.perf_counter() - t0
+    _, _times, total = bench._run_rounds(ecfg, state, step, batches[1:], n_rounds)
+    per_round_ms = total / n_rounds * 1e3
+    return per_round_ms, compile_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(_REPO, "SCALING.md"))
+    args = ap.parse_args()
+
+    import jax
+
+    # honor an explicit JAX_PLATFORMS against platform-pinning site
+    # hooks; otherwise measure whatever backend jax selects (that is
+    # the point of the tool — CPU in CI, the real chip on a TPU host)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    backend = jax.default_backend()
+
+    grid_b = [(16, b) for b in (64, 256, 1024)]          # B sweep at 2^16
+    grid_n = [(n, 256) for n in (14, 16, 18, 20)]        # N sweep at B=256
+    rows = []
+    for cl, b in grid_b + grid_n:
+        ms, comp = measure(cl, b)
+        rows.append((cl, b, ms, comp))
+        print(f"cap=2^{cl} B={b}: {ms:.1f} ms/round "
+              f"({b / ms * 1e3:.0f} ops/s, compile {comp:.0f}s)", flush=True)
+
+    # fits: round_ms vs B at fixed N (linear), per-op ms vs log2(N) at
+    # fixed B (linear in path length)
+    import numpy as np
+
+    bs = np.array([r[1] for r in rows[:len(grid_b)]], float)
+    ms_b = np.array([r[2] for r in rows[:len(grid_b)]], float)
+    slope_b, icept_b = np.polyfit(bs, ms_b, 1)
+    ns = np.array([r[0] for r in rows[len(grid_b):]], float)
+    ms_n = np.array([r[2] for r in rows[len(grid_b):]], float)
+    slope_n, icept_n = np.polyfit(ns, ms_n, 1)
+
+    lines = [
+        "# Engine round-cost scaling (executed)",
+        "",
+        f"Backend: `{backend}` — absolute times are backend-bound; the",
+        "SCALING is the evidence (tools/scaling_study.py docstring).",
+        "",
+        "| capacity | batch B | ms/round | engine ops/s | compile s |",
+        "|---|---|---|---|---|",
+    ]
+    for cl, b, ms, comp in rows:
+        lines.append(
+            f"| 2^{cl} | {b} | {ms:.1f} | {b / ms * 1e3:.0f} | {comp:.0f} |")
+    per_op = [(b, m / b) for _, b, m, _ in rows[:len(grid_b)]]
+    lines += [
+        "",
+        f"- N sweep at B=256: round_ms ≈ {icept_n:.1f} + {slope_n:.2f}·log2(N) —",
+        "  per-round cost grows ~linearly in path length (log N), matching",
+        "  the B·plen gather/scatter + cipher term of PERF.md's model",
+        "  (the repeated 2^16/B=256 row re-measures the first grid point:",
+        "  its ~instant compile is the in-process executable cache hitting",
+        "  on identical shapes);",
+        f"- B sweep at 2^16: round_ms ≈ {icept_b:.1f} + {slope_b:.4f}·B",
+        "  (least-squares; see the per-op view below for why B-linear is",
+        "  only part of the story on a scalar backend);",
+        "- B sweep at 2^16, per-op ms: "
+        + ", ".join(f"{m:.2f} @B={b}" for b, m in per_op) + ".",
+        "  On a SCALAR backend the per-op cost stops improving with B",
+        "  because the [B,B] slot-order semantics (one-hot matmuls and",
+        "  masks, O(B²) work) come to dominate — which is exactly the",
+        "  term the design places on the MXU, where a [2048,2048] bf16",
+        "  matmul is microseconds. The B-amortization of fixed dispatch",
+        "  cost is measured separately (PERF.md: scan-fused vs blocking",
+        "  rounds); this sweep instead bounds the non-MXU share of the",
+        "  round, the part a TPU actually pays per op.",
+        "",
+    ]
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
