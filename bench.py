@@ -423,11 +423,23 @@ def _sharded_subprocess(smoke):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["GRAPEVINE_SHARDED_SUBPROC"] = "1"  # recursion guard
+    _ours = (
+        "xla_force_host_platform_device_count",
+        "xla_cpu_collective_call_warn_stuck_timeout_seconds",
+        "xla_cpu_collective_call_terminate_timeout_seconds",
+    )
     flags = [
         f for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
+        if not any(name in f for name in _ours)
     ]
-    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
+    env["XLA_FLAGS"] = " ".join(flags + [
+        "--xla_force_host_platform_device_count=8",
+        # timesliced virtual devices rendezvous slowly on a loaded
+        # core; the default terminate timeout SIGABRTs spuriously
+        # (BIGRUN_r5.md — it is a flag, not a scale wall)
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+        "--xla_cpu_collective_call_terminate_timeout_seconds=600",
+    ])
     # always smoke-sized shapes: the sim measures host CPU, so big
     # shapes only burn driver wall-clock without adding information
     code = (

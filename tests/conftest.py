@@ -17,9 +17,16 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 timesliced virtual devices rendezvous slowly on a loaded CI core;
+# the default terminate timeout SIGABRTs spuriously at larger test
+# shapes (BIGRUN_r5.md — a flag, not a scale wall). Guard each flag by
+# its own name so ambient values are never overridden by a late append.
+if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+os.environ["XLA_FLAGS"] = _flags
 
 # The env var alone is not enough: plugin site hooks (e.g. the axon PJRT
 # tunnel's sitecustomize) may pin the platform via jax.config, which
