@@ -74,7 +74,7 @@ def _cipher_kernel(
 ):
     """One row tile: (idx [TR, z], val [TR, W-z]) ^= keystream rows."""
     tr = idx_ref.shape[0]
-    n1 = jnp.broadcast_to(bucket_ref[:][:, None], (tr, nb))
+    n1 = jnp.broadcast_to(bucket_ref[:, 0][:, None], (tr, nb))
     n2 = jnp.broadcast_to(epoch_ref[:, 0][:, None], (tr, nb))
     n3 = jnp.broadcast_to(epoch_ref[:, 1][:, None], (tr, nb))
     ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
@@ -99,7 +99,11 @@ def cipher_rows_pallas(
     r, z = pidx.shape
     w = z + pval.shape[1]
     nb = (w + 15) // 16
-    tr = max(8, min(512, _TILE_BYTES // max(1, 16 * nb * 4)))
+    # Mosaic tiling: the row tile is the second-minor block dim of every
+    # rank-2 operand, so it must be a multiple of 8 (the u32 sublane
+    # count); the budget-derived value is rounded down to keep VMEM
+    # bounded, with 8 as the floor
+    tr = max(8, min(512, _TILE_BYTES // max(1, 16 * nb * 4)) // 8 * 8)
     # pad rows to a tile multiple; padded rows carry epoch 0 (identity)
     r_pad = -(-r // tr) * tr
     if r_pad != r:
@@ -115,7 +119,9 @@ def cipher_rows_pallas(
         grid=(r_pad // tr,),
         in_specs=[
             pl.BlockSpec((1, 8), lambda i: (0, 0)),
-            pl.BlockSpec((tr,), lambda i: (i,)),
+            # rank-1 blocks must tile by 128 on TPU; carry the bucket id
+            # as a [rows, 1] column instead so tr only needs 8-alignment
+            pl.BlockSpec((tr, 1), lambda i: (i, 0)),
             pl.BlockSpec((tr, 2), lambda i: (i, 0)),
             pl.BlockSpec((tr, z), lambda i: (i, 0)),
             pl.BlockSpec((tr, w - z), lambda i: (i, 0)),
@@ -129,5 +135,5 @@ def cipher_rows_pallas(
             jax.ShapeDtypeStruct((r_pad, w - z), U32),
         ],
         interpret=interpret,
-    )(key[None, :], bucket, epoch, pidx, pval)
+    )(key[None, :], bucket[:, None], epoch, pidx, pval)
     return oidx[:r], oval[:r]

@@ -41,27 +41,35 @@ U32 = jnp.uint32
 
 def _gather_kernel(
     bucket_ref,  # scalar-prefetch: u32[R] row indices (the public path)
-    key_ref,  # u32[1, 8]
-    idx_row_ref,  # u32[1, z]      tree_idx row bucket_ref[i]
-    val_row_ref,  # u32[1, z*v]    tree_val row bucket_ref[i]
-    nonce_row_ref,  # u32[1, 2]    epoch nonce of that row
-    oidx_ref,  # u32[1, z]
-    oval_ref,  # u32[1, z*v]
+    key_ref,  # u32[1, 1, 8]
+    idx_row_ref,  # u32[1, 1, z]      tree_idx row bucket_ref[i]
+    val_row_ref,  # u32[1, 1, z*v]    tree_val row bucket_ref[i]
+    nonce_row_ref,  # u32[1, 1, 2]    epoch nonce of that row
+    oidx_ref,  # u32[1, 1, z]
+    oval_ref,  # u32[1, 1, z*v]
     *,
     nb,
     z,
     n_words,
     rounds,
 ):
+    # refs are rank-3 [1, 1, width]: Mosaic requires the last TWO block
+    # dims be 8/128-divisible or equal to the array dims, and a gather
+    # block is one non-contiguous row — so rows live on a leading
+    # (untiled) axis and the trailing (1, width) plane equals the array
     i = pl.program_id(0)
     bid = bucket_ref[i]
     n1 = jnp.full((1, nb), bid, U32)
-    n2 = jnp.broadcast_to(nonce_row_ref[0, 0], (1, nb))
-    n3 = jnp.broadcast_to(nonce_row_ref[0, 1], (1, nb))
-    ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
-    written = (nonce_row_ref[0, 0] != U32(0)) | (nonce_row_ref[0, 1] != U32(0))
-    oidx_ref[0, :] = idx_row_ref[0, :] ^ jnp.where(written, ks[0, :z], U32(0))
-    oval_ref[0, :] = val_row_ref[0, :] ^ jnp.where(
+    n2 = jnp.broadcast_to(nonce_row_ref[0, 0, 0], (1, nb))
+    n3 = jnp.broadcast_to(nonce_row_ref[0, 0, 1], (1, nb))
+    ks = keystream_tile(key_ref[0], n1, n2, n3, nb, rounds)
+    written = (
+        (nonce_row_ref[0, 0, 0] != U32(0)) | (nonce_row_ref[0, 0, 1] != U32(0))
+    )
+    oidx_ref[0, 0, :] = idx_row_ref[0, 0, :] ^ jnp.where(
+        written, ks[0, :z], U32(0)
+    )
+    oval_ref[0, 0, :] = val_row_ref[0, 0, :] ^ jnp.where(
         written, ks[0, z:n_words], U32(0)
     )
 
@@ -98,14 +106,20 @@ def gather_decrypt_rows(
         num_scalar_prefetch=1,
         grid=(r,),
         in_specs=[
-            pl.BlockSpec((1, 8), lambda i, b_ref: (0, 0)),
-            pl.BlockSpec((1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
-            pl.BlockSpec((1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
-            pl.BlockSpec((1, 2), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)),
+            pl.BlockSpec((1, 1, 8), lambda i, b_ref: (0, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 2), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((1, z), lambda i, b_ref: (i, 0)),
-            pl.BlockSpec((1, zv), lambda i, b_ref: (i, 0)),
+            pl.BlockSpec((1, 1, z), lambda i, b_ref: (i, 0, 0)),
+            pl.BlockSpec((1, 1, zv), lambda i, b_ref: (i, 0, 0)),
         ],
     )
     oidx, oval = pl.pallas_call(
@@ -114,38 +128,40 @@ def gather_decrypt_rows(
         ),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((r, z), U32),
-            jax.ShapeDtypeStruct((r, zv), U32),
+            jax.ShapeDtypeStruct((r, 1, z), U32),
+            jax.ShapeDtypeStruct((r, 1, zv), U32),
         ],
         interpret=interpret,
-    )(flat_b, key[None, :], idx_rows, tree_val, nonces)
-    return oidx, oval
+    )(flat_b, key[None, None, :], idx_rows[:, None, :], tree_val[:, None, :],
+      nonces[:, None, :])
+    return oidx[:, 0, :], oval[:, 0, :]
 
 
 def _scatter_kernel(
     bucket_ref,  # scalar-prefetch: u32[R] write targets (junk-redirected)
-    key_ref,  # u32[1, 8]
-    idx_new_ref,  # u32[1, z]    plaintext row i to write
-    val_new_ref,  # u32[1, z*v]
-    epoch_ref,  # u32[1, 2]     write epoch (same for all rows)
+    key_ref,  # u32[1, 1, 8]
+    idx_new_ref,  # u32[1, 1, z]    plaintext row i to write
+    val_new_ref,  # u32[1, 1, z*v]
+    epoch_ref,  # u32[1, 1, 2]     write epoch (same for all rows)
     tree_idx_in_ref,  # aliased input (unread; aliasing carries state)
     tree_val_in_ref,  # aliased input (unread)
-    otree_idx_ref,  # u32[1, z]   aliased tree_idx row bucket_ref[i]
-    otree_val_ref,  # u32[1, zv]  aliased tree_val row bucket_ref[i]
+    otree_idx_ref,  # u32[1, 1, z]   aliased tree_idx row bucket_ref[i]
+    otree_val_ref,  # u32[1, 1, zv]  aliased tree_val row bucket_ref[i]
     *,
     nb,
     z,
     n_words,
     rounds,
 ):
+    # rank-3 refs for the same Mosaic tiling reason as _gather_kernel
     i = pl.program_id(0)
     bid = bucket_ref[i]
     n1 = jnp.full((1, nb), bid, U32)
-    n2 = jnp.broadcast_to(epoch_ref[0, 0], (1, nb))
-    n3 = jnp.broadcast_to(epoch_ref[0, 1], (1, nb))
-    ks = keystream_tile(key_ref, n1, n2, n3, nb, rounds)
-    otree_idx_ref[0, :] = idx_new_ref[0, :] ^ ks[0, :z]
-    otree_val_ref[0, :] = val_new_ref[0, :] ^ ks[0, z:n_words]
+    n2 = jnp.broadcast_to(epoch_ref[0, 0, 0], (1, nb))
+    n3 = jnp.broadcast_to(epoch_ref[0, 0, 1], (1, nb))
+    ks = keystream_tile(key_ref[0], n1, n2, n3, nb, rounds)
+    otree_idx_ref[0, 0, :] = idx_new_ref[0, 0, :] ^ ks[0, :z]
+    otree_val_ref[0, 0, :] = val_new_ref[0, 0, :] ^ ks[0, z:n_words]
 
 
 @functools.partial(
@@ -195,21 +211,21 @@ def scatter_encrypt_rows(
         num_scalar_prefetch=1,
         grid=(r,),
         in_specs=[
-            pl.BlockSpec((1, 8), lambda i, b_ref: (0, 0)),
-            pl.BlockSpec((1, z), lambda i, b_ref: (i, 0)),
-            pl.BlockSpec((1, zv), lambda i, b_ref: (i, 0)),
-            pl.BlockSpec((1, 2), lambda i, b_ref: (0, 0)),
+            pl.BlockSpec((1, 1, 8), lambda i, b_ref: (0, 0, 0)),
+            pl.BlockSpec((1, 1, z), lambda i, b_ref: (i, 0, 0)),
+            pl.BlockSpec((1, 1, zv), lambda i, b_ref: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 2), lambda i, b_ref: (0, 0, 0)),
             # aliased tree inputs: unread by the kernel (constant row-0
             # block so the pipeline loads stay trivial)
-            pl.BlockSpec((1, z), lambda i, b_ref: (0, 0)),
-            pl.BlockSpec((1, zv), lambda i, b_ref: (0, 0)),
+            pl.BlockSpec((1, 1, z), lambda i, b_ref: (0, 0, 0)),
+            pl.BlockSpec((1, 1, zv), lambda i, b_ref: (0, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec(
-                (1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)
+                (1, 1, z), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
             ),
             pl.BlockSpec(
-                (1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0)
+                (1, 1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
             ),
         ],
     )
@@ -219,13 +235,14 @@ def scatter_encrypt_rows(
         ),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((n_padded, z), U32),
-            jax.ShapeDtypeStruct((n_padded, zv), U32),
+            jax.ShapeDtypeStruct((n_padded, 1, z), U32),
+            jax.ShapeDtypeStruct((n_padded, 1, zv), U32),
         ],
         # operand indices count ALL inputs incl. the scalar prefetch:
         # tgt=0, key=1, new_pidx=2, new_pval=3, epoch=4, idx_rows=5,
         # tree_val=6
         input_output_aliases={5: 0, 6: 1},
         interpret=interpret,
-    )(tgt, key[None, :], new_pidx, new_pval, epoch[None, :], idx_rows, tree_val)
-    return oidx.reshape(-1), oval
+    )(tgt, key[None, None, :], new_pidx[:, None, :], new_pval[:, None, :],
+      epoch[None, None, :], idx_rows[:, None, :], tree_val[:, None, :])
+    return oidx.reshape(-1), oval[:, 0, :]

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Cross-platform Mosaic lowering check for the three Pallas kernels.
+
+Mosaic's BlockSpec/tiling constraints are enforced at LOWERING time,
+not at execution — so ``jax.export`` with ``platforms=('tpu',)`` runs
+the full Pallas→Mosaic lowering pipeline on a CPU-only host and
+reproduces exactly the class of error the first real TPU window
+surfaced (TPURUN_r5.jsonl mosaic stage: rank-1 block size 86 not a
+multiple of the 128-lane tile). This cannot prove the kernels RUN
+(VMEM fit and Mosaic compile proper happen on-device), but it proves
+the lowering contract the window rejected.
+
+Shapes checked are the real engine geometries, taken from the same
+configs the TPU capture's mosaic stage and the bench's headline config
+instantiate:
+  - records tree rows: z=4 slot words + 4 slots x 255 value words
+  - mailbox rows: two-choice table rows (engine/vphases.py)
+plus the exact (172-row, nb=24) case that failed on the first window.
+
+Run:  JAX_PLATFORMS=cpu python tools/mosaic_lowering_check.py
+Exit code 0 = every kernel lowers for TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# the axon PJRT sitecustomize overrides JAX_PLATFORMS via jax.config, so
+# pin through jax.config too (same workaround as tests/conftest.py) —
+# otherwise this checker initializes the tunneled TPU backend and blocks
+# whenever another process holds the single-claim relay
+jax.config.update("jax_platforms", "cpu")
+
+U32 = jnp.uint32
+
+
+def _lower_tpu(fn, *args, **static):
+    """jax.export against an abstract TPU mesh: runs Mosaic lowering."""
+    from jax import export
+
+    wrapped = jax.jit(functools.partial(fn, **static))
+    specs = [
+        jax.ShapeDtypeStruct(a.shape, a.dtype) if hasattr(a, "shape") else a
+        for a in args
+    ]
+    export.export(wrapped, platforms=("tpu",))(*specs)
+
+
+def check_cipher(r, z, vw):
+    from grapevine_tpu.oblivious.pallas_cipher import cipher_rows_pallas
+
+    key = jnp.zeros((8,), U32)
+    bucket = jnp.zeros((r,), U32)
+    epoch = jnp.zeros((r, 2), U32)
+    pidx = jnp.zeros((r, z), U32)
+    pval = jnp.zeros((r, vw), U32)
+    _lower_tpu(cipher_rows_pallas, key, bucket, epoch, pidx, pval,
+               rounds=8, interpret=False)
+
+
+def check_gather(n, r, z, v):
+    from grapevine_tpu.oblivious.pallas_gather import gather_decrypt_rows
+
+    key = jnp.zeros((8,), U32)
+    tree_idx = jnp.zeros((n * z,), U32)
+    tree_val = jnp.zeros((n, z * v), U32)
+    nonces = jnp.zeros((n, 2), U32)
+    flat_b = jnp.zeros((r,), U32)
+    _lower_tpu(gather_decrypt_rows, key, tree_idx, tree_val, nonces,
+               flat_b, z=z, rounds=8, interpret=False)
+
+
+def check_scatter(n, r, z, v):
+    from grapevine_tpu.oblivious.pallas_gather import scatter_encrypt_rows
+
+    key = jnp.zeros((8,), U32)
+    tree_idx = jnp.zeros((n * z,), U32)
+    tree_val = jnp.zeros((n, z * v), U32)
+    flat_b = jnp.zeros((r,), U32)
+    owner = jnp.zeros((r,), jnp.bool_)
+    epoch = jnp.zeros((2,), U32)
+    new_pidx = jnp.zeros((r, z), U32)
+    new_pval = jnp.zeros((r, z * v), U32)
+    _lower_tpu(scatter_encrypt_rows, key, tree_idx, tree_val, flat_b,
+               owner, epoch, new_pidx, new_pval, z=z, rounds=8,
+               interpret=False)
+
+
+CASES = [
+    # (name, thunk) — geometries from the engine's two trees at the
+    # capture/bench configs, plus the exact first-window failure shape
+    ("cipher records r=172 (failed on TPU window 1)",
+     lambda: check_cipher(172, 4, 380)),
+    ("cipher records B=2048-ish path set",
+     lambda: check_cipher(40960, 4, 1020 - 4)),
+    ("cipher mailbox rows", lambda: check_cipher(352, 4, 60)),
+    ("cipher tiny (cap 2^6 smoke)", lambda: check_cipher(14, 4, 1016)),
+    ("gather records", lambda: check_gather(2048, 1320, 4, 254)),
+    ("gather tiny", lambda: check_gather(65, 22, 4, 254)),
+    ("scatter records", lambda: check_scatter(2048, 1320, 4, 254)),
+    ("scatter tiny", lambda: check_scatter(65, 22, 4, 254)),
+]
+
+
+def main():
+    bad = 0
+    for name, thunk in CASES:
+        try:
+            thunk()
+            print(f"OK    {name}")
+        except Exception as e:  # noqa: BLE001 — report-all checker
+            bad += 1
+            msg = str(e).split("\n")[0][:300]
+            print(f"FAIL  {name}: {type(e).__name__}: {msg}")
+    print(f"{len(CASES) - bad}/{len(CASES)} kernels lower for TPU")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
