@@ -1,13 +1,19 @@
 #!/bin/bash
 # TPU relay probe loop (VERDICT r4 next-round #1: "retry periodically
-# all round"). Appends one line per attempt to PROBELOG_r5.md; on the
-# first success it harvests all TPU evidence via tools/tpu_capture.py
-# (quick pass first, then full-size) and exits so the session can run
-# follow-up TPU work serialized (the relay is one weak core).
+# all round"). Appends one line per attempt to PROBELOG_r5.md; on each
+# success it harvests TPU evidence via tools/tpu_capture.py (quick pass
+# first, then full-size), then RESUMES probing — window 1 closed after
+# ~5 minutes with most stages uncaptured, so later windows must
+# re-harvest whatever is still missing (the artifact is append-only;
+# per-window skip logic below keeps re-runs cheap).
 #
 # "UP" requires a TPU-class backend name: "tpu" (direct plugin) or
 # "axon" (the relay tunnel's platform name, BENCH_r02.json). A cpu
 # fallback probe must NOT stop the loop or trigger a harvest.
+#
+# Cadence: window 1 lasted ~5 min, so the down-cycle must be shorter
+# than that: 120 s probe timeout (a live relay answers in ~10 s) +
+# 150 s sleep ≈ 4.5 min worst-case detection latency.
 LOG=/root/repo/PROBELOG_r5.md
 OUT=/root/repo/TPURUN_r5.jsonl
 if [ ! -f "$LOG" ]; then
@@ -21,7 +27,7 @@ if [ ! -f "$LOG" ]; then
 fi
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  out=$(timeout 300 python - <<'EOF' 2>&1
+  out=$(timeout 120 python - <<'EOF' 2>&1
 import time, jax, jax.numpy as jnp
 t0 = time.time()
 x = jnp.ones((256, 256), jnp.float32)
@@ -43,9 +49,17 @@ EOF
     # append-only across windows, and a passing stage from an earlier
     # window (possibly older code) must not suppress a re-run
     n0=$(wc -l < "$OUT" 2>/dev/null || echo 0)
-    timeout 7200 python tools/tpu_capture.py --quick \
-      >> /tmp/tpu_capture_quick.log 2>&1
-    echo "- $ts: quick capture rc=$? (TPURUN_r5.jsonl)" >> "$LOG"
+    # the quick pass exists to guarantee SOME numbers from a short
+    # window; once any window has banked a quick headline, later
+    # windows skip straight to the full-size pass (window 1 lasted
+    # ~5 min — a re-run of the quick pass would have eaten all of it)
+    if grep -q '"stage": "headline".*"ops_per_sec"' "$OUT" 2>/dev/null; then
+      echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): quick pass skipped (headline already banked)" >> "$LOG"
+    else
+      timeout 7200 python tools/tpu_capture.py --quick \
+        >> /tmp/tpu_capture_quick.log 2>&1
+      echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): quick capture rc=$? (TPURUN_r5.jsonl)" >> "$LOG"
+    fi
     fresh=$(tail -n +$((n0 + 1)) "$OUT" 2>/dev/null)
     skip=""
     echo "$fresh" | grep -q '"stage": "mosaic".*"bit_identical": true' \
@@ -59,12 +73,13 @@ EOF
     fi
     timeout 7200 python tools/tpu_capture.py ${skip:+--skip "$skip"} \
       >> /tmp/tpu_capture_full.log 2>&1
-    echo "- $ts: full capture rc=$? (skip='${skip}', TPURUN_r5.jsonl)" >> "$LOG"
-    exit 0
+    echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): full capture rc=$? (skip='${skip}', TPURUN_r5.jsonl)" >> "$LOG"
+    # resume probing: the next window re-harvests anything still missing
+    sleep 150
   else
     err=$(echo "$out" | tail -1 | cut -c1-120)
-    [ $rc -eq 124 ] && err="timeout after 300s"
+    [ $rc -eq 124 ] && err="timeout after 120s"
     echo "- $ts: down (rc=$rc; $err)" >> "$LOG"
+    sleep 150
   fi
-  sleep 420
 done

@@ -110,6 +110,59 @@ def stage_headline(cap, args):
     _zipf_run(cap, "headline", "jnp", cl, b, 8)
 
 
+def stage_micro(cap, args):
+    """Component microbench at the headline geometry: decomposes the
+    engine round into its device primitives so a short window still
+    pinpoints the bottleneck (window 1: measured 33 ms/round at
+    B=256/2^16 vs the ~2-5 ms analytic model — a 30x gap whose prime
+    suspect is XLA:TPU's serial dynamic scatter on the tree write-back,
+    the exact op the fused Pallas scatter kernel replaces)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from grapevine_tpu.oblivious.bucket_cipher import row_keystream
+
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    plen = cl - 1 + 2  # tree levels at density 2, incl. root+leaf fringe
+    n = 1 << (cl + 1)  # padded bucket count, density 2
+    rows = b * plen
+    w = 1020
+    key = jnp.arange(8, dtype=jnp.uint32)
+    rng = np.random.default_rng(0)
+    tree = jnp.asarray(rng.integers(0, 2**31, (n, w)), jnp.uint32)
+    flat_b = jnp.asarray(
+        rng.choice(n - 1, size=rows, replace=False), jnp.uint32)
+    new_rows = jnp.asarray(rng.integers(0, 2**31, (rows, w)), jnp.uint32)
+    sort_keys = jnp.asarray(rng.integers(0, 2**31, (rows * 8,)), jnp.uint32)
+    epoch = jnp.ones((rows, 2), jnp.uint32)
+
+    def timed(name, fn, *xs):
+        f = jax.jit(fn)
+        out = f(*xs)
+        jax.block_until_ready(out)  # compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(*xs)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        return name, round(float(np.median(ts)) * 1e3, 3)
+
+    res = dict([
+        timed("gather_rows_ms", lambda t, i: t[i], tree, flat_b),
+        timed("scatter_rows_ms",
+              lambda t, i, v: t.at[i].set(v), tree, flat_b, new_rows),
+        timed("argsort_ms", lambda k: jnp.argsort(k), sort_keys),
+        timed("chacha_keystream_ms",
+              lambda k, bkt, ep: row_keystream(k, bkt, ep, w, 8),
+              key, flat_b, epoch),
+        timed("xor_rows_ms", lambda a, v: a ^ v, new_rows, new_rows),
+    ])
+    cap.emit("micro", capacity_log2=cl, batch=b, path_rows=rows,
+             row_words=w, **res)
+
+
 def stage_mosaic(cap, args):
     """All three kernels Mosaic-compiled on TPU; engine round results +
     final state bit-identical across cipher impls (cipher ON), junk
@@ -268,10 +321,14 @@ def stage_trace(cap, args):
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
+    ("micro", stage_micro, 900),
     ("mosaic", stage_mosaic, 1200),
+    # trace before pallas_perf: it reuses the headline's compiled
+    # program (shared cache), so it is nearly free — and the first
+    # window proved windows can close in minutes
+    ("trace", stage_trace, 900),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("oblivious", stage_oblivious, 900),
-    ("trace", stage_trace, 900),
     ("fullbench", None, 2400),  # subprocess-only (see main loop)
 ]
 
@@ -354,16 +411,34 @@ def main():
                "--stage", name, "--out", args.out]
         if args.quick:
             cmd.append("--quick")
+        wedged = False
         try:
             rc = subprocess.run(cmd, timeout=cap_s).returncode
         except subprocess.TimeoutExpired:
             cap.emit(name, error=f"stage killed after {cap_s}s "
                      "(wedged dispatch; child process terminated)")
-            rc = -1
+            rc, wedged = -1, True
         if rc != 0:
             failures += 1
             if name == "probe":
                 break  # no usable backend — nothing else can run
+        if wedged:
+            # A wedge usually means the relay died mid-window (window 1:
+            # every stage after the first wedge also wedged, burning
+            # 3x900s on a dead tunnel). Re-probe cheaply; if the relay
+            # cannot answer a 256x256 matmul, the window is over.
+            try:
+                prc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--stage", "probe", "--out", args.out],
+                    timeout=180,
+                ).returncode
+            except subprocess.TimeoutExpired:
+                prc = -1
+            if prc != 0:
+                cap.emit("abort", reason=f"window closed (re-probe failed "
+                         f"after {name} wedged)")
+                break
     cap.emit("done", failures=failures)
     return 1 if failures else 0
 
