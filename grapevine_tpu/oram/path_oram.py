@@ -284,7 +284,10 @@ def _path_scatter(
         if owner is None:
             return tree.at[path_b].set(new_vals, unique_indices=True)
         tgt = jnp.where(owner, path_b, U32(tree.shape[0]))
-        return tree.at[tgt].set(new_vals, mode="drop")
+        # in-bounds targets are unique by construction: the owner map
+        # gives every heap bucket exactly one owning column, so at most
+        # one write lands on any row (the rest drop out of bounds)
+        return tree.at[tgt].set(new_vals, mode="drop", unique_indices=True)
     n_local = tree.shape[0]
     base = (jax.lax.axis_index(axis_name) * n_local).astype(U32)
     loc = path_b - base
@@ -292,7 +295,7 @@ def _path_scatter(
     if owner is not None:
         mine = mine & owner
     tgt = jnp.where(mine, loc, U32(n_local))  # out of range = dropped
-    return tree.at[tgt].set(new_vals, mode="drop")
+    return tree.at[tgt].set(new_vals, mode="drop", unique_indices=True)
 
 
 def path_slot_indices(cfg: OramConfig, path_b: jax.Array) -> jax.Array:
