@@ -143,10 +143,14 @@ def oram_round(
     # --- 1. dedup, position-map read/remap, path fetch -----------------
     first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
-    # last occurrence wins the remap; others retarget the throwaway
-    # dummy-index slot (posmap[blocks] backs cfg.dummy_index)
-    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.blocks))
-    posmap = state.posmap.at[remap_tgt].set(new_leaves)
+    # last occurrence wins the remap; others drop out of bounds (the
+    # dummy slot posmap[blocks] is never read unmasked, so funneling
+    # dead writes there — the old scheme — only forced the scatter to
+    # assume colliding indices; dropping keeps in-bounds targets unique)
+    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.blocks + 1))
+    posmap = state.posmap.at[remap_tgt].set(
+        new_leaves, mode="drop", unique_indices=True
+    )
 
     path_b = jax.vmap(lambda lf: path_bucket_indices(cfg, lf))(leaves)  # [B,plen]
     flat_b = path_b.reshape(b * plen)
@@ -193,9 +197,12 @@ def oram_round(
     # that costs O(B·W) — ~3·10^8 bools per round at B=2048. The map is
     # private working memory, same standing as the posmap.
     iota_w = jnp.arange(w, dtype=U32)
+    # non-real rows (SENTINEL, dummy) drop out of bounds: a live block
+    # occupies exactly one working-set row, so in-bounds targets are
+    # unique and the scatter can use the parallel lowering
     row_map = jnp.full((cfg.blocks + 2,), U32(w)).at[
-        jnp.minimum(widx0, U32(cfg.blocks + 1))
-    ].set(iota_w)  # SENTINEL rows land in the junk slot blocks+1
+        jnp.where(widx0 < U32(cfg.blocks), widx0, U32(cfg.blocks + 2))
+    ].set(iota_w, mode="drop", unique_indices=True)
     pos0 = row_map[jnp.minimum(idxs, U32(cfg.blocks))]  # u32[B]; w = absent
     present0 = pos0 != U32(w)
     pos0 = jnp.minimum(pos0, U32(w - 1))
@@ -268,15 +275,25 @@ def oram_round(
         jnp.zeros((w,), jnp.bool_).at[eperm].set(placed, unique_indices=True)
     )
 
-    new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(widx, mode="drop")
-    new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(wval, mode="drop")
+    # eviction slots are unique by construction (rank < z within a
+    # bucket, disjoint slot ranges across buckets); unplaced rows drop
+    new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(
+        widx, mode="drop", unique_indices=True
+    )
+    new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(
+        wval, mode="drop", unique_indices=True
+    )
 
     # --- 4. stash recompaction + write-back ----------------------------
     leftover = valid & ~placed
     srank = rank_of(leftover)
     starget = jnp.where(leftover, srank, s)  # OOB = dropped
-    stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(widx, mode="drop")
-    stash_val = jnp.zeros((s, v), U32).at[starget].set(wval, mode="drop")
+    stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(
+        widx, mode="drop", unique_indices=True
+    )
+    stash_val = jnp.zeros((s, v), U32).at[starget].set(
+        wval, mode="drop", unique_indices=True
+    )
     n_left = jnp.sum(leftover.astype(jnp.int32))
     stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
 
