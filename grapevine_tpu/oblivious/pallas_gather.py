@@ -145,8 +145,10 @@ def _scatter_kernel(
     epoch_ref,  # u32[1, 1, 2]     write epoch (same for all rows)
     tree_idx_in_ref,  # aliased input (unread; aliasing carries state)
     tree_val_in_ref,  # aliased input (unread)
+    nonces_in_ref,  # aliased input (unread)
     otree_idx_ref,  # u32[1, 1, z]   aliased tree_idx row bucket_ref[i]
     otree_val_ref,  # u32[1, 1, zv]  aliased tree_val row bucket_ref[i]
+    ononce_ref,  # u32[1, 1, 2]     aliased nonce row bucket_ref[i]
     *,
     nb,
     z,
@@ -162,17 +164,21 @@ def _scatter_kernel(
     ks = keystream_tile(key_ref[0], n1, n2, n3, nb, rounds)
     otree_idx_ref[0, 0, :] = idx_new_ref[0, 0, :] ^ ks[0, :z]
     otree_val_ref[0, 0, :] = val_new_ref[0, 0, :] ^ ks[0, z:n_words]
+    # the write epoch rides the same pass — the separate XLA nonce
+    # scatter the jnp path pays (round.py) has no fused-path cost at all
+    ononce_ref[0, 0, :] = epoch_ref[0, 0, :]
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("z", "rounds", "interpret"),
-    donate_argnums=(1, 2),
+    donate_argnums=(1, 2, 3),
 )
 def scatter_encrypt_rows(
     key: jax.Array,  # u32[8]
     tree_idx: jax.Array,  # u32[n_padded * z] (flat; updated in place)
     tree_val: jax.Array,  # u32[n_padded, z*v] (updated in place)
+    nonces: jax.Array,  # u32[n_padded, 2] (updated in place)
     flat_b: jax.Array,  # u32[R] heap-bucket targets (public transcript)
     owner: jax.Array,  # bool[R]; False rows must not write
     epoch: jax.Array,  # u32[2] the write epoch for every owned row
@@ -192,9 +198,11 @@ def scatter_encrypt_rows(
     Non-owner rows (duplicate-bucket fetch copies) are redirected to
     the padded junk bucket, which heap indices never address; owner
     targets are unique, so writes never conflict (the junk row takes
-    several writes — last wins, never read).
+    several writes — last wins, never read). The per-row write epoch
+    (nonce) is committed in the same pass, so the fused path needs no
+    separate XLA nonce scatter.
 
-    Returns the updated ``(tree_idx, tree_val)``.
+    Returns the updated ``(tree_idx, tree_val, nonces)``.
     """
     n_padded = tree_val.shape[0]
     zv = tree_val.shape[1]
@@ -219,6 +227,7 @@ def scatter_encrypt_rows(
             # block so the pipeline loads stay trivial)
             pl.BlockSpec((1, 1, z), lambda i, b_ref: (0, 0, 0)),
             pl.BlockSpec((1, 1, zv), lambda i, b_ref: (0, 0, 0)),
+            pl.BlockSpec((1, 1, 2), lambda i, b_ref: (0, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -227,9 +236,12 @@ def scatter_encrypt_rows(
             pl.BlockSpec(
                 (1, 1, zv), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
             ),
+            pl.BlockSpec(
+                (1, 1, 2), lambda i, b_ref: (b_ref[i].astype(jnp.int32), 0, 0)
+            ),
         ],
     )
-    oidx, oval = pl.pallas_call(
+    oidx, oval, ononce = pl.pallas_call(
         functools.partial(
             _scatter_kernel, nb=nb, z=z, n_words=w, rounds=rounds
         ),
@@ -237,12 +249,14 @@ def scatter_encrypt_rows(
         out_shape=[
             jax.ShapeDtypeStruct((n_padded, 1, z), U32),
             jax.ShapeDtypeStruct((n_padded, 1, zv), U32),
+            jax.ShapeDtypeStruct((n_padded, 1, 2), U32),
         ],
         # operand indices count ALL inputs incl. the scalar prefetch:
         # tgt=0, key=1, new_pidx=2, new_pval=3, epoch=4, idx_rows=5,
-        # tree_val=6
-        input_output_aliases={5: 0, 6: 1},
+        # tree_val=6, nonces=7
+        input_output_aliases={5: 0, 6: 1, 7: 2},
         interpret=interpret,
     )(tgt, key[None, None, :], new_pidx[:, None, :], new_pval[:, None, :],
-      epoch[None, None, :], idx_rows[:, None, :], tree_val[:, None, :])
-    return oidx.reshape(-1), oval[:, 0, :]
+      epoch[None, None, :], idx_rows[:, None, :], tree_val[:, None, :],
+      nonces[:, None, :])
+    return oidx.reshape(-1), oval[:, 0, :], ononce[:, 0, :]

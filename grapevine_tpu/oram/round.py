@@ -303,12 +303,14 @@ def oram_round(
     epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
     if axis_name is None and cfg.cipher_impl == "pallas_fused" and cfg.encrypted:
         # single-chip fast path: encrypt + scatter in ONE HBM pass (the
-        # write-back mirror of the fused fetch; pallas_gather.py)
+        # write-back mirror of the fused fetch; pallas_gather.py) —
+        # the nonce commit rides the same kernel, so this branch has no
+        # XLA scatter at all
         from ..oblivious.pallas_gather import scatter_encrypt_rows
 
-        tree_idx_new, tree_val_new = scatter_encrypt_rows(
-            state.cipher_key, state.tree_idx, state.tree_val, flat_b,
-            fowner, state.epoch,
+        tree_idx_new, tree_val_new, nonces = scatter_encrypt_rows(
+            state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
+            flat_b, fowner, state.epoch,
             new_pidx.reshape(b * plen, z),
             new_pval.reshape(b * plen, z * v),
             z=z, rounds=cfg.cipher_rounds,
@@ -330,11 +332,11 @@ def oram_round(
         tree_val_new = _path_scatter(
             state.tree_val, flat_b, enc_pval, axis_name, fowner
         )
-    nonces = (
-        _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
-        if cfg.encrypted
-        else state.nonces
-    )
+        nonces = (
+            _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
+            if cfg.encrypted
+            else state.nonces
+        )
     new_state = OramState(
         tree_idx=tree_idx_new,
         tree_val=tree_val_new,

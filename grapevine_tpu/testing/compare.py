@@ -37,6 +37,10 @@ def states_equal_excluding_junk(sa, sb):
             val = la[key[: -len("tree_idx")] + "tree_val"]
             z = x.size // val.shape[0]
             x, y = x[:-z], y[:-z]
+        elif key.endswith("nonces"):
+            # the fused kernel also commits the write epoch through the
+            # junk redirect, so the junk bucket's nonce row differs too
+            x, y = x[:-1], y[:-1]
         if not np.array_equal(x, y):
             return False, key
     return True, None

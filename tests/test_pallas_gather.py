@@ -149,6 +149,7 @@ def test_scatter_encrypt_matches_encrypt_then_scatter():
     zv = z * v
     tree_idx = jnp.asarray(rng.integers(0, 2**31, (n * z,)), jnp.uint32)
     tree_val = jnp.asarray(rng.integers(0, 2**31, (n, zv)), jnp.uint32)
+    nonces = jnp.asarray(rng.integers(0, 3, (n, 2)), jnp.uint32)
     key = jnp.asarray(rng.integers(0, 2**31, (8,)), jnp.uint32)
     epoch = jnp.asarray([7, 0], jnp.uint32)
     flat_b = jnp.asarray([3, 9, 3, 20], jnp.uint32)  # 3 duplicated
@@ -159,12 +160,14 @@ def test_scatter_encrypt_matches_encrypt_then_scatter():
     # (in-place update is the point), so the inputs die with the call
     orig_i = np.asarray(tree_idx).reshape(n, z).copy()
     orig_v = np.asarray(tree_val).copy()
-    oi, ov = scatter_encrypt_rows(
-        key, tree_idx, tree_val, flat_b, owner, epoch, new_pidx, new_pval,
-        z=z, rounds=8, interpret=True,
+    orig_n = np.asarray(nonces).copy()
+    oi, ov, on = scatter_encrypt_rows(
+        key, tree_idx, tree_val, nonces, flat_b, owner, epoch, new_pidx,
+        new_pval, z=z, rounds=8, interpret=True,
     )
     oi = np.asarray(oi).reshape(n, z)
     ov = np.asarray(ov)
+    on = np.asarray(on)
     ks = row_keystream(
         key, flat_b, jnp.broadcast_to(epoch[None, :], (4, 2)), z + zv, 8
     )
@@ -177,6 +180,8 @@ def test_scatter_encrypt_matches_encrypt_then_scatter():
         if row in (3, 9, 20):
             assert np.array_equal(oi[row], ref_i[row]), f"idx row {row}"
             assert np.array_equal(ov[row], ref_v[row]), f"val row {row}"
+            assert np.array_equal(on[row], np.asarray(epoch)), f"nonce {row}"
         else:
             assert np.array_equal(oi[row], orig_i[row]), row
             assert np.array_equal(ov[row], orig_v[row]), row
+            assert np.array_equal(on[row], orig_n[row]), f"nonce {row}"

@@ -86,13 +86,14 @@ def check_scatter(n, r, z, v):
     key = jnp.zeros((8,), U32)
     tree_idx = jnp.zeros((n * z,), U32)
     tree_val = jnp.zeros((n, z * v), U32)
+    nonces = jnp.zeros((n, 2), U32)
     flat_b = jnp.zeros((r,), U32)
     owner = jnp.zeros((r,), jnp.bool_)
     epoch = jnp.zeros((2,), U32)
     new_pidx = jnp.zeros((r, z), U32)
     new_pval = jnp.zeros((r, z * v), U32)
-    _lower_tpu(scatter_encrypt_rows, key, tree_idx, tree_val, flat_b,
-               owner, epoch, new_pidx, new_pval, z=z, rounds=8,
+    _lower_tpu(scatter_encrypt_rows, key, tree_idx, tree_val, nonces,
+               flat_b, owner, epoch, new_pidx, new_pval, z=z, rounds=8,
                interpret=False)
 
 
