@@ -1,0 +1,55 @@
+"""TPU Mosaic lowering contract for the Pallas kernels (CPU-hosted).
+
+Interpret-mode tests prove kernel SEMANTICS but not the Mosaic tiling
+contract — all three kernels passed interpret-mode CI for two rounds
+while the first real TPU window rejected them at lowering (rank-1 block
+of 86 rows: neither full-array nor 128-aligned; TPURUN_r5.jsonl).
+``jax.export(platforms=("tpu",))`` runs the Pallas→Mosaic lowering
+pipeline on a CPU-only host, so this gate catches the whole class
+without hardware. Full geometry sweep: tools/mosaic_lowering_check.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+
+from grapevine_tpu.oblivious.pallas_cipher import cipher_rows_pallas
+from grapevine_tpu.oblivious.pallas_gather import (
+    gather_decrypt_rows,
+    scatter_encrypt_rows,
+)
+
+U32 = jnp.uint32
+
+
+def _lower_tpu(fn, *specs, **static):
+    export.export(jax.jit(functools.partial(fn, **static)),
+                  platforms=("tpu",))(*specs)
+
+
+def _s(*shape):
+    return jax.ShapeDtypeStruct(shape, U32)
+
+
+@pytest.mark.parametrize("r,z,vw", [(172, 4, 380), (14, 4, 1016)])
+def test_cipher_kernel_lowers_for_tpu(r, z, vw):
+    _lower_tpu(cipher_rows_pallas, _s(8), _s(r), _s(r, 2), _s(r, z),
+               _s(r, vw), rounds=8, interpret=False)
+
+
+def test_gather_kernel_lowers_for_tpu():
+    n, r, z, v = 65, 22, 4, 254
+    _lower_tpu(gather_decrypt_rows, _s(8), _s(n * z), _s(n, z * v),
+               _s(n, 2), _s(r), z=z, rounds=8, interpret=False)
+
+
+def test_scatter_kernel_lowers_for_tpu():
+    n, r, z, v = 65, 22, 4, 254
+    specs = [_s(8), _s(n * z), _s(n, z * v), _s(n, 2), _s(r),
+             jax.ShapeDtypeStruct((r,), jnp.bool_), _s(2), _s(r, z),
+             _s(r, z * v)]
+    _lower_tpu(scatter_encrypt_rows, *specs, z=z, rounds=8,
+               interpret=False)
