@@ -282,7 +282,7 @@ def bench_zipf_pallas(smoke, impl="pallas"):
     from grapevine_tpu.config import TPU_BACKENDS
 
     backend = jax.default_backend()
-    if impl == "pallas_fused" and backend not in TPU_BACKENDS:
+    if impl in ("pallas_fused", "pallas_fused_tiled") and backend not in TPU_BACKENDS:
         # The fused gather's grid is one step per fetched row, and
         # interpret mode traces every grid step into the jit — ~60 s of
         # tracing at B=2048, so real shapes are Mosaic-only. But the
@@ -290,24 +290,24 @@ def bench_zipf_pallas(smoke, impl="pallas"):
         # encrypt+scatter path) must produce an executed number every
         # round, not only when a TPU shows up: run ONE toy-shape round
         # and report it under a key that cannot be mistaken for perf.
-        return _fused_plumbing_proof()
+        return _fused_plumbing_proof(impl)
     if not smoke and backend not in TPU_BACKENDS:
         return {"skipped": f"needs a TPU backend for Mosaic (have {backend!r})"}
     return bench_zipf_mixed(smoke, cipher_impl=impl)
 
 
-def _fused_plumbing_proof():
-    """Tiny interpret-mode engine rounds through cipher_impl=
-    "pallas_fused" (cap 2^6, B=2): proves the bench→engine→fused-kernel
-    plumbing executes end to end on this backend. The time is dominated
-    by interpret-mode tracing at compile; the steady-state round time is
+def _fused_plumbing_proof(impl="pallas_fused"):
+    """Tiny interpret-mode engine rounds through the given fused cipher
+    impl (cap 2^6, B=2): proves the bench→engine→fused-kernel plumbing
+    executes end to end on this backend. The time is dominated by
+    interpret-mode tracing at compile; the steady-state round time is
     reported separately and is NOT a perf claim (Mosaic numbers come
     from a TPU backend run of this same config)."""
     import jax
 
     from grapevine_tpu.engine.state import ID_WORDS, KEY_WORDS, PAYLOAD_WORDS
 
-    cfg, ecfg, state, step = _mk_engine(1 << 6, 1 << 3, 2, cipher_impl="pallas_fused")
+    cfg, ecfg, state, step = _mk_engine(1 << 6, 1 << 3, 2, cipher_impl=impl)
     rng = np.random.default_rng(5)
     me = rng.integers(1, 2**31, (KEY_WORDS,)).astype(np.uint32)
     pl = rng.integers(0, 2**31, (PAYLOAD_WORDS,)).astype(np.uint32)
@@ -549,6 +549,8 @@ CONFIGS = [
     ("batched_read", bench_batched_read),
     ("zipf_pallas_cipher", bench_zipf_pallas),
     ("zipf_pallas_fused", lambda smoke: bench_zipf_pallas(smoke, "pallas_fused")),
+    ("zipf_pallas_tiled",
+     lambda smoke: bench_zipf_pallas(smoke, "pallas_fused_tiled")),
     ("crd_loop", bench_crd_loop),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),

@@ -71,11 +71,14 @@ class GrapevineConfig:
     bucket_cipher_rounds: int = 8
     #: cipher implementation: "jnp" (XLA, keystream materialized in
     #: HBM), "pallas" (fused VMEM keystream+XOR kernel,
-    #: oblivious/pallas_cipher.py), or "pallas_fused" ("pallas" plus the
+    #: oblivious/pallas_cipher.py), "pallas_fused" ("pallas" plus the
     #: path fetch fused into the decrypt — one HBM pass per fetched row,
     #: oblivious/pallas_gather.py; single-chip fetches only, the sharded
-    #: path keeps decrypt-after-psum so plaintext never transits ICI).
-    #: Interpret mode off-TPU; bit-identical ciphertext in all three.
+    #: path keeps decrypt-after-psum so plaintext never transits ICI),
+    #: or "pallas_fused_tiled" (same contract, 8 rows per grid step via
+    #: manual HBM->VMEM DMAs — amortizes grid overhead and fills the
+    #: ChaCha tile's sublanes). Interpret mode off-TPU; bit-identical
+    #: ciphertext in all four.
     bucket_cipher_impl: str = "jnp"
     #: per-request signature scheme: "schnorrkel" (sr25519, byte-compatible
     #: with the reference's sign_schnorrkel clients — README.md:193-199,
@@ -98,10 +101,13 @@ class GrapevineConfig:
             raise ValueError(
                 f"bucket_cipher_rounds must be 0 or an even value >= 8, got {r}"
             )
-        if self.bucket_cipher_impl not in ("jnp", "pallas", "pallas_fused"):
+        if self.bucket_cipher_impl not in (
+            "jnp", "pallas", "pallas_fused", "pallas_fused_tiled"
+        ):
             raise ValueError(
-                f"bucket_cipher_impl must be 'jnp', 'pallas' or "
-                f"'pallas_fused', got {self.bucket_cipher_impl!r}"
+                f"bucket_cipher_impl must be 'jnp', 'pallas', "
+                f"'pallas_fused' or 'pallas_fused_tiled', got "
+                f"{self.bucket_cipher_impl!r}"
             )
         if self.signature_scheme not in ("schnorrkel", "rfc9496"):
             raise ValueError(

@@ -84,7 +84,7 @@ def cipher_rows(
     if not cfg.encrypted:
         return pidx, pval
     z = cfg.bucket_slots
-    if cfg.cipher_impl in ("pallas", "pallas_fused"):
+    if cfg.cipher_impl in ("pallas", "pallas_fused", "pallas_fused_tiled"):
         from ..oblivious.pallas_cipher import cipher_rows_pallas
 
         interpret = jax.default_backend() not in _TPU_BACKENDS

@@ -159,13 +159,20 @@ def oram_round(
     fowner = bmap[flat_b] == cols_flat
 
     slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
-    if axis_name is None and cfg.cipher_impl == "pallas_fused" and cfg.encrypted:
+    fused = cfg.cipher_impl in ("pallas_fused", "pallas_fused_tiled")
+    if axis_name is None and fused and cfg.encrypted:
         # single-chip fast path: gather + decrypt in ONE HBM pass
         # (oblivious/pallas_gather.py); the sharded path below keeps
         # decrypt-after-psum so tree plaintext never transits ICI
-        from ..oblivious.pallas_gather import gather_decrypt_rows
+        from ..oblivious.pallas_gather import (
+            gather_decrypt_rows,
+            gather_decrypt_rows_tiled,
+        )
 
-        pidx, pval = gather_decrypt_rows(
+        g = (gather_decrypt_rows_tiled
+             if cfg.cipher_impl == "pallas_fused_tiled"
+             else gather_decrypt_rows)
+        pidx, pval = g(
             state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
             flat_b, z=z, rounds=cfg.cipher_rounds,
             interpret=jax.default_backend() not in _TPU_BACKENDS,
@@ -301,14 +308,20 @@ def oram_round(
     # shares the bucket's owner bit
     fowner_slots = jnp.repeat(fowner, z)
     epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
-    if axis_name is None and cfg.cipher_impl == "pallas_fused" and cfg.encrypted:
+    if axis_name is None and fused and cfg.encrypted:
         # single-chip fast path: encrypt + scatter in ONE HBM pass (the
         # write-back mirror of the fused fetch; pallas_gather.py) —
         # the nonce commit rides the same kernel, so this branch has no
         # XLA scatter at all
-        from ..oblivious.pallas_gather import scatter_encrypt_rows
+        from ..oblivious.pallas_gather import (
+            scatter_encrypt_rows,
+            scatter_encrypt_rows_tiled,
+        )
 
-        tree_idx_new, tree_val_new, nonces = scatter_encrypt_rows(
+        sc = (scatter_encrypt_rows_tiled
+              if cfg.cipher_impl == "pallas_fused_tiled"
+              else scatter_encrypt_rows)
+        tree_idx_new, tree_val_new, nonces = sc(
             state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
             flat_b, fowner, state.epoch,
             new_pidx.reshape(b * plen, z),

@@ -19,7 +19,9 @@ from jax import export
 from grapevine_tpu.oblivious.pallas_cipher import cipher_rows_pallas
 from grapevine_tpu.oblivious.pallas_gather import (
     gather_decrypt_rows,
+    gather_decrypt_rows_tiled,
     scatter_encrypt_rows,
+    scatter_encrypt_rows_tiled,
 )
 
 U32 = jnp.uint32
@@ -40,16 +42,21 @@ def test_cipher_kernel_lowers_for_tpu(r, z, vw):
                _s(r, vw), rounds=8, interpret=False)
 
 
-def test_gather_kernel_lowers_for_tpu():
+@pytest.mark.parametrize(
+    "fn", [gather_decrypt_rows, gather_decrypt_rows_tiled]
+)
+def test_gather_kernel_lowers_for_tpu(fn):
     n, r, z, v = 65, 22, 4, 254
-    _lower_tpu(gather_decrypt_rows, _s(8), _s(n * z), _s(n, z * v),
+    _lower_tpu(fn, _s(8), _s(n * z), _s(n, z * v),
                _s(n, 2), _s(r), z=z, rounds=8, interpret=False)
 
 
-def test_scatter_kernel_lowers_for_tpu():
+@pytest.mark.parametrize(
+    "fn", [scatter_encrypt_rows, scatter_encrypt_rows_tiled]
+)
+def test_scatter_kernel_lowers_for_tpu(fn):
     n, r, z, v = 65, 22, 4, 254
     specs = [_s(8), _s(n * z), _s(n, z * v), _s(n, 2), _s(r),
              jax.ShapeDtypeStruct((r,), jnp.bool_), _s(2), _s(r, z),
              _s(r, z * v)]
-    _lower_tpu(scatter_encrypt_rows, *specs, z=z, rounds=8,
-               interpret=False)
+    _lower_tpu(fn, *specs, z=z, rounds=8, interpret=False)

@@ -68,20 +68,27 @@ def check_cipher(r, z, vw):
                rounds=8, interpret=False)
 
 
-def check_gather(n, r, z, v):
-    from grapevine_tpu.oblivious.pallas_gather import gather_decrypt_rows
+def check_gather(n, r, z, v, tiled=False):
+    from grapevine_tpu.oblivious.pallas_gather import (
+        gather_decrypt_rows,
+        gather_decrypt_rows_tiled,
+    )
 
     key = jnp.zeros((8,), U32)
     tree_idx = jnp.zeros((n * z,), U32)
     tree_val = jnp.zeros((n, z * v), U32)
     nonces = jnp.zeros((n, 2), U32)
     flat_b = jnp.zeros((r,), U32)
-    _lower_tpu(gather_decrypt_rows, key, tree_idx, tree_val, nonces,
+    fn = gather_decrypt_rows_tiled if tiled else gather_decrypt_rows
+    _lower_tpu(fn, key, tree_idx, tree_val, nonces,
                flat_b, z=z, rounds=8, interpret=False)
 
 
-def check_scatter(n, r, z, v):
-    from grapevine_tpu.oblivious.pallas_gather import scatter_encrypt_rows
+def check_scatter(n, r, z, v, tiled=False):
+    from grapevine_tpu.oblivious.pallas_gather import (
+        scatter_encrypt_rows,
+        scatter_encrypt_rows_tiled,
+    )
 
     key = jnp.zeros((8,), U32)
     tree_idx = jnp.zeros((n * z,), U32)
@@ -92,7 +99,8 @@ def check_scatter(n, r, z, v):
     epoch = jnp.zeros((2,), U32)
     new_pidx = jnp.zeros((r, z), U32)
     new_pval = jnp.zeros((r, z * v), U32)
-    _lower_tpu(scatter_encrypt_rows, key, tree_idx, tree_val, nonces,
+    fn = scatter_encrypt_rows_tiled if tiled else scatter_encrypt_rows
+    _lower_tpu(fn, key, tree_idx, tree_val, nonces,
                flat_b, owner, epoch, new_pidx, new_pval, z=z, rounds=8,
                interpret=False)
 
@@ -110,6 +118,13 @@ CASES = [
     ("gather tiny", lambda: check_gather(65, 22, 4, 254)),
     ("scatter records", lambda: check_scatter(2048, 1320, 4, 254)),
     ("scatter tiny", lambda: check_scatter(65, 22, 4, 254)),
+    ("gather tiled records",
+     lambda: check_gather(2048, 1320, 4, 254, tiled=True)),
+    ("gather tiled tiny", lambda: check_gather(65, 22, 4, 254, tiled=True)),
+    ("scatter tiled records",
+     lambda: check_scatter(2048, 1320, 4, 254, tiled=True)),
+    ("scatter tiled tiny",
+     lambda: check_scatter(65, 22, 4, 254, tiled=True)),
 ]
 
 

@@ -211,7 +211,7 @@ def stage_mosaic(cap, args):
     import bench
 
     outs = {}
-    for impl in ("jnp", "pallas", "pallas_fused"):
+    for impl in ("jnp", "pallas", "pallas_fused", "pallas_fused_tiled"):
         t0 = time.perf_counter()
         cfg, ecfg, state, step = bench._mk_engine(
             1 << 10, 1 << 6, 16, cipher_impl=impl
@@ -230,7 +230,7 @@ def stage_mosaic(cap, args):
                  wall_s=round(time.perf_counter() - t0, 1))
     ok = True
     detail = {}
-    for impl in ("pallas", "pallas_fused"):
+    for impl in ("pallas", "pallas_fused", "pallas_fused_tiled"):
         same = all(
             all(np.array_equal(outs["jnp"][0][i][k], outs[impl][0][i][k])
                 for k in outs["jnp"][0][i])
@@ -251,8 +251,10 @@ def stage_mosaic(cap, args):
 
 def stage_pallas_perf(cap, args):
     cl, b = (16, 256) if args.quick else (20, 2048)
-    _zipf_run(cap, "pallas_perf", "pallas", cl, b, 8)
+    # tiled first: per-step overhead makes it the best bet at full size
+    _zipf_run(cap, "pallas_perf", "pallas_fused_tiled", cl, b, 8)
     _zipf_run(cap, "pallas_perf", "pallas_fused", cl, b, 8)
+    _zipf_run(cap, "pallas_perf", "pallas", cl, b, 8)
 
 
 def stage_oblivious(cap, args):
