@@ -461,7 +461,9 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             jnp.where(claim_ok, glast, U32(b)),
             jnp.where(claim_ok, jnp.argmax(claim_slot_oh, axis=1).astype(U32), U32(k)),
         )
-        keys_fin = keys0.at[ctgt].set(ka, mode="drop")
+        # at most one claim per group (claim_ok), and claims target
+        # their group-representative row — in-bounds targets unique
+        keys_fin = keys0.at[ctgt].set(ka, mode="drop", unique_indices=True)
 
         # initial entries: survivors shift down by popped_init per slot
         # T[r,s]: total pops in r's group landing on slot s
@@ -514,7 +516,10 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             ],
             axis=1,
         )
-        ents_fin = ents_fin.at[etgt].set(new_entry, mode="drop")
+        # distinct (group row, slot, rank) per surviving create — unique
+        ents_fin = ents_fin.at[etgt].set(
+            new_entry, mode="drop", unique_indices=True
+        )
 
         assembled = _mb_pack_batch(ecfg, keys_fin, ents_fin)  # [B,V]
         assembled_alive = jnp.any(~is_zero_words(keys_fin), axis=1)  # [B]
