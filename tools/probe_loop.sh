@@ -16,6 +16,11 @@
 # 150 s sleep ≈ 4.5 min worst-case detection latency.
 LOG=/root/repo/PROBELOG_r5.md
 OUT=/root/repo/TPURUN_r5.jsonl
+# Hard deadline (epoch s): the axon tunnel is single-claim, so a
+# capture still running when the DRIVER's end-of-round bench starts
+# would force BENCH_r05 into cpu-fallback — the loop must be long gone
+# by then. Override via PROBE_DEADLINE for other sessions.
+DEADLINE=${PROBE_DEADLINE:-1785507900}
 if [ ! -f "$LOG" ]; then
   {
     echo "# TPU relay probe log — round 5"
@@ -26,6 +31,12 @@ if [ ! -f "$LOG" ]; then
   } >> "$LOG"
 fi
 while true; do
+  now=$(date -u +%s)
+  left=$((DEADLINE - now))
+  if [ "$left" -le 180 ]; then
+    echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): probe loop exiting (deadline; tunnel released for the driver bench)" >> "$LOG"
+    exit 0
+  fi
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   out=$(timeout 120 python - <<'EOF' 2>&1
 import time, jax, jax.numpy as jnp
@@ -49,6 +60,13 @@ EOF
     # append-only across windows, and a passing stage from an earlier
     # window (possibly older code) must not suppress a re-run
     n0=$(wc -l < "$OUT" 2>/dev/null || echo 0)
+    # never let a capture run past the deadline (minus teardown margin)
+    cap=$((DEADLINE - $(date -u +%s) - 240))
+    [ "$cap" -gt 7200 ] && cap=7200
+    if [ "$cap" -lt 600 ]; then
+      echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): window found but too close to deadline; not capturing" >> "$LOG"
+      exit 0
+    fi
     # the quick pass exists to guarantee SOME numbers from a short
     # window; once any window has banked a quick headline, later
     # windows skip straight to the full-size pass (window 1 lasted
@@ -56,7 +74,7 @@ EOF
     if grep -q '"stage": "headline".*"ops_per_sec"' "$OUT" 2>/dev/null; then
       echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): quick pass skipped (headline already banked)" >> "$LOG"
     else
-      timeout 7200 python tools/tpu_capture.py --quick \
+      timeout "$cap" python tools/tpu_capture.py --quick \
         >> /tmp/tpu_capture_quick.log 2>&1
       echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): quick capture rc=$? (TPURUN_r5.jsonl)" >> "$LOG"
     fi
@@ -71,7 +89,13 @@ EOF
       && ! echo "$fresh" | grep -q '"stage": "oblivious".*"error"'; then
       skip="${skip:+$skip,}oblivious"
     fi
-    timeout 7200 python tools/tpu_capture.py ${skip:+--skip "$skip"} \
+    cap=$((DEADLINE - $(date -u +%s) - 240))
+    [ "$cap" -gt 7200 ] && cap=7200
+    if [ "$cap" -lt 600 ]; then
+      echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): full pass skipped (deadline)" >> "$LOG"
+      exit 0
+    fi
+    timeout "$cap" python tools/tpu_capture.py ${skip:+--skip "$skip"} \
       >> /tmp/tpu_capture_full.log 2>&1
     echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ): full capture rc=$? (skip='${skip}', TPURUN_r5.jsonl)" >> "$LOG"
     # resume probing: the next window re-harvests anything still missing
