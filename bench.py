@@ -531,9 +531,18 @@ def bench_server_loopback(smoke):
         total = time.perf_counter() - t0
         assert not errs, errs[0]
         ops = n_clients * per_client * 2  # create + read per iteration
+        # per-phase p99s from the obs registry: where the full-stack
+        # round budget actually went (assembly window vs verify vs
+        # device vs demux) — the breakdown Palermo-style perf work needs
+        phases = {
+            k.split("phase=", 1)[1].split("}", 1)[0]: v
+            for k, v in server.metrics_registry.snapshot().items()
+            if k.startswith("grapevine_phase_seconds{") and k.endswith("_p99")
+        }
         return {
             "ops_per_sec": round(ops / total, 1),
             "p99_pair_ms": round(_p99(lat), 2),
+            "phase_p99_s": phases,
             "clients": n_clients,
             "capacity_log2": cap.bit_length() - 1,
         }

@@ -127,12 +127,20 @@ class PendingRound:
         self._t0 = t0
 
     def resolve(self) -> list[QueryResponse]:
-        out = unpack_responses(self._resp, self._n)  # device sync happens here
+        m = self._engine.metrics
+        # "evict" = device round completion measured from the host: the
+        # jit'd fetch/apply/evict/write-back program finishes inside this
+        # wait (per-stage device splits live in the profiler trace via
+        # jax.named_scope — the host cannot time inside one XLA program)
+        with m.time_phase("evict"):
+            jax.block_until_ready(self._resp)
+        with m.time_phase("demux"):
+            out = unpack_responses(self._resp, self._n)
         # recorded duration = dispatch → results delivered. Under the
         # pipelined scheduler this includes the next round's collection
         # window (resolve runs after the next dispatch), i.e. it is the
         # round *commit latency* a client observes, not pure device time
-        self._engine.metrics.record_round(
+        m.record_round(
             self._n, self._engine.ecfg.batch_size, time.perf_counter() - self._t0
         )
         return out
@@ -200,9 +208,12 @@ class GrapevineEngine:
         if len(reqs) > bs:
             raise ValueError("async path is one round at a time")
         with self._lock:
-            batch = pack_batch(reqs, bs, now)
-            t0 = time.perf_counter()
-            self.state, resp, _ = self._step(self.ecfg, self.state, batch)
+            # "dispatch" = host pack + async device enqueue (JAX returns
+            # at enqueue; the device round itself lands in "evict")
+            with self.metrics.time_phase("dispatch"):
+                batch = pack_batch(reqs, bs, now)
+                t0 = time.perf_counter()
+                self.state, resp, _ = self._step(self.ecfg, self.state, batch)
         return PendingRound(self, resp, len(reqs), t0)
 
     def handle_queries_with_transcript(self, reqs, now):
@@ -224,13 +235,15 @@ class GrapevineEngine:
             return 0
         with self._lock:
             before = int(self.state.free_top)
-            self.state = self._sweep(
-                self.ecfg,
-                self.state,
-                np.uint32(int(now) & 0xFFFFFFFF),
-                np.uint32(period),
-                np.uint32((int(now) >> 32) & 0xFFFFFFFF),
-            )
+            with self.metrics.time_phase("sweep"):
+                self.state = self._sweep(
+                    self.ecfg,
+                    self.state,
+                    np.uint32(int(now) & 0xFFFFFFFF),
+                    np.uint32(period),
+                    np.uint32((int(now) >> 32) & 0xFFFFFFFF),
+                )
+                jax.block_until_ready(self.state.free_top)
             evicted = int(self.state.free_top) - before
             self.metrics.record_sweep(evicted)
             return evicted
@@ -243,18 +256,25 @@ class GrapevineEngine:
     def recipient_count(self) -> int:
         return int(self.state.recipients)
 
-    def health(self) -> dict:
-        """Aggregate state + batch-level counters (never per-client).
+    def sample_stash(self) -> None:
+        """Sample stash occupancy of both trees into the metrics gauges.
 
-        Stash occupancy is sampled here rather than per round: a device
+        Called at scrape/health cadence, not per round: a device
         reduction every round would serialize the dispatch pipeline for
-        a gauge that is only read at scrape time."""
+        a gauge that is only read between scrapes (it is also the
+        /metrics endpoint's pre-scrape refresh hook, obs/httpd.py)."""
         from ..oram.path_oram import stash_occupancy
 
         with self._lock:
-            state = self.state  # one round's state for a consistent snapshot
+            state = self.state
             for tree in (state.rec, state.mb):
                 self.metrics.observe_stash(int(stash_occupancy(tree)))
+
+    def health(self) -> dict:
+        """Aggregate state + batch-level counters (never per-client)."""
+        self.sample_stash()
+        with self._lock:
+            state = self.state  # one round's state for a consistent snapshot
             return {
                 "messages": self.ecfg.max_messages - int(state.free_top),
                 "recipients": int(state.recipients),

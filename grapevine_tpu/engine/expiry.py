@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..oblivious.bucket_cipher import epoch_next, row_keystream
 from ..oblivious.primitives import SENTINEL, is_zero_words, u64_le, u64_sub
+from ..obs.phases import device_phase
 from ..oram.path_oram import OramConfig, OramState
 from .state import (
     ENT_SEQ,
@@ -133,7 +134,8 @@ def expiry_sweep(
         return present, (ix, vl)
 
     present0 = jnp.zeros((n_msgs,), jnp.bool_)
-    present, rec = _chunked_tree_sweep(rcfg, state.rec, present0, rec_body)
+    with device_phase("sweep_records"):
+        present, rec = _chunked_tree_sweep(rcfg, state.rec, present0, rec_body)
 
     # stash rows are plaintext private state
     st_live = state.rec.stash_idx != SENTINEL
@@ -187,9 +189,10 @@ def expiry_sweep(
         new_idx, out_val, keys = sweep_mb(ix, vl)
         return cnt + live_keys(keys, new_idx), (new_idx, out_val)
 
-    recips, mb = _chunked_tree_sweep(
-        ecfg.mb, state.mb, jnp.zeros((), U32), mb_body
-    )
+    with device_phase("sweep_mailbox"):
+        recips, mb = _chunked_tree_sweep(
+            ecfg.mb, state.mb, jnp.zeros((), U32), mb_body
+        )
     mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(
         state.mb.stash_idx, state.mb.stash_val
     )

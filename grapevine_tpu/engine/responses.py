@@ -12,12 +12,21 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..obs.phases import device_phase
 from ..wire import constants as C
 
 U32 = jnp.uint32
 
 
-def assemble_responses(
+def assemble_responses(*args, **kwargs):
+    """Trace-annotated wrapper; see ``_assemble_responses`` for the
+    semantics. The named scope makes the response-assembly HLO show up
+    as its own span in TPU profiler captures (obs/phases.py)."""
+    with device_phase("respond"):
+        return _assemble_responses(*args, **kwargs)
+
+
+def _assemble_responses(
     *,
     is_real,
     is_create,

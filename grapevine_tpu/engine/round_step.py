@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from ..oblivious.primitives import is_zero_words, rank_of
 from ..oblivious.prp import prp2_decrypt
+from ..obs.phases import device_phase
 from ..wire import constants as C
 from ..oram.round import oram_round
 from .responses import assemble_responses
@@ -151,10 +152,11 @@ def engine_round_step(
         "msg_id": msg_id,
         "payload": payload,
     }
-    mb1, out_a, leaf_a = oram_round(
-        ecfg.mb, state.mb, idxs_mb_flat, nl_a, dl_a,
-        phase_a_batch(ecfg, ctx), axis_name,
-    )
+    with device_phase("round_a_mailbox"):
+        mb1, out_a, leaf_a = oram_round(
+            ecfg.mb, state.mb, idxs_mb_flat, nl_a, dl_a,
+            phase_a_batch(ecfg, ctx), axis_name,
+        )
     free_top = state.free_top - out_a["n_allocs"]
     recipients = state.recipients + out_a["n_claims"]
     seq_lo, seq_hi = u64_add_u32(state.seq[0], state.seq[1], U32(b))
@@ -184,9 +186,11 @@ def engine_round_step(
         "sel_blk": out_a["sel_blk"],
         "sel_idw": out_a["sel_idw"],
     }
-    rec1, out_b, leaf_b = oram_round(
-        ecfg.rec, state.rec, idx_b, nl_b, dl_b, phase_b_batch(ecfg, ctx_b), axis_name
-    )
+    with device_phase("round_b_records"):
+        rec1, out_b, leaf_b = oram_round(
+            ecfg.rec, state.rec, idx_b, nl_b, dl_b,
+            phase_b_batch(ecfg, ctx_b), axis_name,
+        )
 
     # freed blocks return to the freelist in slot order — one vectorized
     # scatter, visible only to the next batch (phase-major commit rule)
@@ -204,10 +208,11 @@ def engine_round_step(
         "upd_ok": out_b["upd_ok"],
         "rm_a": out_a["rm_a"],
     }
-    mb2, _out_c, leaf_c = oram_round(
-        ecfg.mb, mb1, idxs_mb_flat, nl_c, dl_c,
-        phase_c_batch(ecfg, ctx_c), axis_name,
-    )
+    with device_phase("round_c_mailbox"):
+        mb2, _out_c, leaf_c = oram_round(
+            ecfg.mb, mb1, idxs_mb_flat, nl_c, dl_c,
+            phase_c_batch(ecfg, ctx_c), axis_name,
+        )
 
     # ---- response assembly (shared with the op-major engine) ----------
     responses = assemble_responses(

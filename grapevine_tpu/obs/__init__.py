@@ -1,0 +1,35 @@
+"""Obliviousness-safe observability (the telemetry analog of
+testing/leakcheck.py).
+
+The engine's security claim constrains *telemetry*, not just storage:
+per-op timing or op-type breakdowns would reopen exactly the side
+channel the oblivious engine closes (reference grapevine.proto:120-122
+— "access patterns and timings"). This package therefore enforces the
+leak policy structurally rather than by convention:
+
+- ``registry``: a central TelemetryRegistry (counters, gauges,
+  histograms with fixed bucket boundaries) with a declarative allowlist
+  of label keys and registration-time-declared label values — a metric
+  keyed by client identity, msg id, or op type raises
+  ``TelemetryLeakError`` at registration, and ``audit()`` asserts the
+  whole registry is batch-level only;
+- ``phases``: the canonical round-phase names, wall-clock phase timers
+  feeding the registry, and ``jax`` trace annotations for TPU profiler
+  runs;
+- ``exporter``: Prometheus text exposition of a registry;
+- ``httpd``: a stdlib ``http.server`` thread serving ``/metrics`` and
+  ``/healthz``.
+"""
+
+from .registry import (  # noqa: F401
+    ALLOWED_LABEL_KEYS,
+    FORBIDDEN_LABEL_KEYS,
+    Counter,
+    Gauge,
+    Histogram,
+    TelemetryLeakError,
+    TelemetryRegistry,
+)
+from .phases import PHASES, device_phase, phase_timer  # noqa: F401
+from .exporter import render_prometheus  # noqa: F401
+from .httpd import MetricsServer  # noqa: F401

@@ -1,0 +1,121 @@
+"""The /metrics + /healthz endpoint: a stdlib http.server thread.
+
+Deliberately not a gRPC method on the public service: scrapers and
+load-balancer health checks speak plain HTTP, and the endpoint must stay
+up (and truthful) when the engine wedges — so it runs on its own daemon
+thread with no dependency on the gRPC executor or the collector loop.
+
+Leak stance: the endpoint serves only the registry (already audited to
+be batch-level) and a healthz verdict. It binds wherever the operator
+points ``--metrics-port``; like the engine tier's Submit listener, keep
+it on localhost or a private scrape network — batch-level metrics are
+safe against the *clients*, but operational telemetry is still nobody
+else's business.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .exporter import render_prometheus
+from .registry import TelemetryRegistry
+
+log = logging.getLogger("grapevine_tpu.obs")
+
+
+class MetricsServer:
+    """Serve ``/metrics`` (Prometheus text) and ``/healthz`` (JSON).
+
+    ``health`` is a zero-arg callable returning ``(healthy: bool,
+    detail: dict)``; unhealthy renders HTTP 503 so any LB/probe flips
+    without parsing the body. The callable runs on the scrape thread —
+    it must not take engine locks that a wedged round could hold.
+    """
+
+    def __init__(
+        self,
+        registry: TelemetryRegistry,
+        health=None,
+        refresh=None,
+        host: str = "127.0.0.1",
+        port: int = 9464,
+    ):
+        self.registry = registry
+        self.health = health or (lambda: (True, {}))
+        #: optional zero-arg pre-scrape hook: sample pull-style gauges
+        #: (stash occupancy needs a device sync, which must happen at
+        #: scrape cadence, not round cadence). Runs only for /metrics —
+        #: /healthz must stay lock-free and answer while a round wedges.
+        self.refresh = refresh
+        self._host = host
+        self._port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # scrapes are not access-log news
+                log.debug("metrics http: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    if outer.refresh is not None:
+                        try:
+                            outer.refresh()
+                        except Exception:
+                            log.exception("metrics refresh hook failed")
+                    body = render_prometheus(outer.registry).encode()
+                    self._reply(
+                        200, body, "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                elif path == "/healthz":
+                    try:
+                        healthy, detail = outer.health()
+                    except Exception as exc:  # a broken probe is unhealthy
+                        healthy, detail = False, {"error": repr(exc)}
+                    body = json.dumps(
+                        {"healthy": bool(healthy), **detail}
+                    ).encode()
+                    self._reply(
+                        200 if healthy else 503, body, "application/json"
+                    )
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="grapevine-metrics",
+        )
+        self._thread.start()
+        port = self._httpd.server_address[1]
+        log.info("metrics endpoint on %s:%d (/metrics, /healthz)",
+                 self._host, port)
+        return port
+
+    @property
+    def port(self) -> int | None:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from ..oblivious.bucket_cipher import epoch_next, row_keystream  # noqa: F401  (row_keystream used by cipher_rows)
 from ..oblivious.primitives import SENTINEL, first_true_onehot, onehot_select, rank_of
+from ..obs.phases import device_phase
 
 U32 = jnp.uint32
 
@@ -352,12 +353,13 @@ def oram_access(
     slot_b = path_slot_indices(cfg, path_b).reshape(-1)  # u32[plen*z]
 
     # --- fetch path ∪ stash into the working set -----------------------
-    pidx = _path_gather(state.tree_idx, slot_b, axis_name)
-    pval = _path_gather(state.tree_val, path_b, axis_name)
-    pnonce = _path_gather(state.nonces, path_b, axis_name)
-    pidx, pval = cipher_rows(
-        cfg, state.cipher_key, path_b, pnonce, pidx.reshape(plen, z), pval
-    )
+    with device_phase("oram_fetch"):
+        pidx = _path_gather(state.tree_idx, slot_b, axis_name)
+        pval = _path_gather(state.tree_val, path_b, axis_name)
+        pnonce = _path_gather(state.nonces, path_b, axis_name)
+        pidx, pval = cipher_rows(
+            cfg, state.cipher_key, path_b, pnonce, pidx.reshape(plen, z), pval
+        )
     pidx = pidx.reshape(-1)
     pval = pval.reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
@@ -390,24 +392,25 @@ def oram_access(
     insert_dropped = do_insert & ~inserted
 
     # --- greedy deepest-first eviction ---------------------------------
-    valid = widx != SENTINEL
-    depth = _common_prefix_depth(cfg, wleaf, leaf)  # int32[W]
-    assign = jnp.full(valid.shape, -1, jnp.int32)  # path level, -1 = stash
-    pos = jnp.zeros(valid.shape, jnp.int32)  # slot within the bucket
-    placed = jnp.zeros(valid.shape, jnp.bool_)
-    for level in range(cfg.height, -1, -1):
-        eligible = valid & ~placed & (depth >= level)
-        r = rank_of(eligible)
-        chosen = eligible & (r < z)
-        assign = jnp.where(chosen, level, assign)
-        pos = jnp.where(chosen, r, pos)
-        placed = placed | chosen
+    with device_phase("oram_evict"):
+        valid = widx != SENTINEL
+        depth = _common_prefix_depth(cfg, wleaf, leaf)  # int32[W]
+        assign = jnp.full(valid.shape, -1, jnp.int32)  # path level, -1 = stash
+        pos = jnp.zeros(valid.shape, jnp.int32)  # slot within the bucket
+        placed = jnp.zeros(valid.shape, jnp.bool_)
+        for level in range(cfg.height, -1, -1):
+            eligible = valid & ~placed & (depth >= level)
+            r = rank_of(eligible)
+            chosen = eligible & (r < z)
+            assign = jnp.where(chosen, level, assign)
+            pos = jnp.where(chosen, r, pos)
+            placed = placed | chosen
 
-    # scatter placed entries into fresh path arrays (conflict-free: each
-    # (level, pos) pair is chosen at most once)
-    target = jnp.where(placed, assign * z + pos, plen * z)  # OOB = dropped
-    new_pidx = jnp.full((plen * z,), SENTINEL, U32).at[target].set(widx, mode="drop")
-    new_pval = jnp.zeros((plen * z, v), U32).at[target].set(wval, mode="drop")
+        # scatter placed entries into fresh path arrays (conflict-free:
+        # each (level, pos) pair is chosen at most once)
+        target = jnp.where(placed, assign * z + pos, plen * z)  # OOB = dropped
+        new_pidx = jnp.full((plen * z,), SENTINEL, U32).at[target].set(widx, mode="drop")
+        new_pval = jnp.zeros((plen * z, v), U32).at[target].set(wval, mode="drop")
 
     # --- compact the leftovers back into the stash ---------------------
     leftover = valid & ~placed
@@ -428,20 +431,21 @@ def oram_access(
     )
 
     # --- write the path back (write transcript ≡ read transcript) ------
-    epochs_w = jnp.broadcast_to(state.epoch[None, :], (plen, 2))
-    enc_pidx, enc_pval = cipher_rows(
-        cfg,
-        state.cipher_key,
-        path_b,
-        epochs_w,
-        new_pidx.reshape(plen, z),
-        new_pval.reshape(plen, z * v),
-    )
-    nonces = (
-        _path_scatter(state.nonces, path_b, epochs_w, axis_name)
-        if cfg.encrypted
-        else state.nonces
-    )
+    with device_phase("oram_writeback"):
+        epochs_w = jnp.broadcast_to(state.epoch[None, :], (plen, 2))
+        enc_pidx, enc_pval = cipher_rows(
+            cfg,
+            state.cipher_key,
+            path_b,
+            epochs_w,
+            new_pidx.reshape(plen, z),
+            new_pval.reshape(plen, z * v),
+        )
+        nonces = (
+            _path_scatter(state.nonces, path_b, epochs_w, axis_name)
+            if cfg.encrypted
+            else state.nonces
+        )
     new_state = OramState(
         tree_idx=_path_scatter(
             state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, rank_of
 from ..oblivious.bucket_cipher import epoch_next
+from ..obs.phases import device_phase
 from .path_oram import (
     OramConfig,
     OramState,
@@ -160,34 +161,35 @@ def oram_round(
 
     slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
     fused = cfg.cipher_impl in ("pallas_fused", "pallas_fused_tiled")
-    if axis_name is None and fused and cfg.encrypted:
-        # single-chip fast path: gather + decrypt in ONE HBM pass
-        # (oblivious/pallas_gather.py); the sharded path below keeps
-        # decrypt-after-psum so tree plaintext never transits ICI
-        from ..oblivious.pallas_gather import (
-            gather_decrypt_rows,
-            gather_decrypt_rows_tiled,
-        )
+    with device_phase("oram_fetch"):
+        if axis_name is None and fused and cfg.encrypted:
+            # single-chip fast path: gather + decrypt in ONE HBM pass
+            # (oblivious/pallas_gather.py); the sharded path below keeps
+            # decrypt-after-psum so tree plaintext never transits ICI
+            from ..oblivious.pallas_gather import (
+                gather_decrypt_rows,
+                gather_decrypt_rows_tiled,
+            )
 
-        g = (gather_decrypt_rows_tiled
-             if cfg.cipher_impl == "pallas_fused_tiled"
-             else gather_decrypt_rows)
-        pidx, pval = g(
-            state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
-            flat_b, z=z, rounds=cfg.cipher_rounds,
-            interpret=jax.default_backend() not in _TPU_BACKENDS,
-        )
-    else:
-        pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(
-            b * plen, z
-        )
-        pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
-        pnonce = _path_gather(state.nonces, flat_b, axis_name)
-        pidx, pval = cipher_rows(
-            cfg, state.cipher_key, flat_b, pnonce, pidx, pval
-        )
-    # non-owner copies of shared buckets are invalidated
-    pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
+            g = (gather_decrypt_rows_tiled
+                 if cfg.cipher_impl == "pallas_fused_tiled"
+                 else gather_decrypt_rows)
+            pidx, pval = g(
+                state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
+                flat_b, z=z, rounds=cfg.cipher_rounds,
+                interpret=jax.default_backend() not in _TPU_BACKENDS,
+            )
+        else:
+            pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(
+                b * plen, z
+            )
+            pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
+            pnonce = _path_gather(state.nonces, flat_b, axis_name)
+            pidx, pval = cipher_rows(
+                cfg, state.cipher_key, flat_b, pnonce, pidx, pval
+            )
+        # non-owner copies of shared buckets are invalidated
+        pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
 
     w = s + nslots + b  # + b reserved rows for net inserts
     widx0 = jnp.concatenate(
@@ -217,7 +219,8 @@ def oram_round(
         present0[:, None], wval0[pos0.astype(jnp.int32)], 0
     )  # u32[B, V]
 
-    outs, final_val, final_alive = apply_batch(vals0, present0)
+    with device_phase("oram_apply"):
+        outs, final_val, final_alive = apply_batch(vals0, present0)
 
     # --- final per-key state → working-set rows ------------------------
     # the round's last op on each key commits the callback's final state:
@@ -247,109 +250,111 @@ def oram_round(
     # prefix), so within-bucket ranks are segmented cumsums — O(W) work
     # per level with no [W, B] masks (which at B=1024, plen=21 would be
     # ~10^8 bools per level).
-    valid = widx != SENTINEL
-    skey = jnp.where(valid, wleaf, U32(0xFFFFFFFF))
-    eperm = jnp.argsort(skey)
-    sleaf = skey[eperm]
-    svalid = valid[eperm]
-    iota_w = jnp.arange(w, dtype=jnp.int32)
-    placed = jnp.zeros((w,), jnp.bool_)  # sorted order
-    slot_tgt_s = jnp.full((w,), nslots, U32)  # sorted order; OOB = unplaced
-    for level in range(h, -1, -1):
-        shift = U32(h - level)
-        bid = sleaf >> shift  # bucket prefix per entry; sorted ⇒ contiguous
-        hb = (U32(1) << U32(level)) - U32(1) + bid  # heap bucket index
-        # one gather answers both "was my bucket fetched" (owner != B)
-        # and "which column's output rows hold it"
-        oc = bmap[jnp.minimum(hb, U32(cfg.n_buckets_padded - 1))]
-        bnd = jnp.concatenate(
-            [jnp.ones((1,), jnp.bool_), bid[1:] != bid[:-1]]
+    with device_phase("oram_evict"):
+        valid = widx != SENTINEL
+        skey = jnp.where(valid, wleaf, U32(0xFFFFFFFF))
+        eperm = jnp.argsort(skey)
+        sleaf = skey[eperm]
+        svalid = valid[eperm]
+        iota_w = jnp.arange(w, dtype=jnp.int32)
+        placed = jnp.zeros((w,), jnp.bool_)  # sorted order
+        slot_tgt_s = jnp.full((w,), nslots, U32)  # sorted order; OOB = unplaced
+        for level in range(h, -1, -1):
+            shift = U32(h - level)
+            bid = sleaf >> shift  # bucket prefix per entry; sorted ⇒ contiguous
+            hb = (U32(1) << U32(level)) - U32(1) + bid  # heap bucket index
+            # one gather answers both "was my bucket fetched" (owner != B)
+            # and "which column's output rows hold it"
+            oc = bmap[jnp.minimum(hb, U32(cfg.n_buckets_padded - 1))]
+            bnd = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), bid[1:] != bid[:-1]]
+            )
+            elig = svalid & ~placed & (oc != U32(b))
+            ei = elig.astype(jnp.int32)
+            ecum = jnp.cumsum(ei) - ei  # exclusive count of eligibles
+            start = jax.lax.cummax(jnp.where(bnd, iota_w, 0))  # my segment start
+            rank = ecum - ecum[start]  # exclusive rank within my bucket
+            chosen = elig & (rank < z)
+            slot = (oc * U32(plen) + U32(level)) * U32(z) + rank.astype(U32)
+            slot_tgt_s = jnp.where(chosen, slot, slot_tgt_s)
+            placed = placed | chosen
+        # back to working-set order (a [W] scatter, so values need no permute)
+        slot_tgt = (
+            jnp.full((w,), nslots, U32).at[eperm].set(slot_tgt_s, unique_indices=True)
         )
-        elig = svalid & ~placed & (oc != U32(b))
-        ei = elig.astype(jnp.int32)
-        ecum = jnp.cumsum(ei) - ei  # exclusive count of eligibles
-        start = jax.lax.cummax(jnp.where(bnd, iota_w, 0))  # my segment start
-        rank = ecum - ecum[start]  # exclusive rank within my bucket
-        chosen = elig & (rank < z)
-        slot = (oc * U32(plen) + U32(level)) * U32(z) + rank.astype(U32)
-        slot_tgt_s = jnp.where(chosen, slot, slot_tgt_s)
-        placed = placed | chosen
-    # back to working-set order (a [W] scatter, so values need no permute)
-    slot_tgt = (
-        jnp.full((w,), nslots, U32).at[eperm].set(slot_tgt_s, unique_indices=True)
-    )
-    placed = (
-        jnp.zeros((w,), jnp.bool_).at[eperm].set(placed, unique_indices=True)
-    )
+        placed = (
+            jnp.zeros((w,), jnp.bool_).at[eperm].set(placed, unique_indices=True)
+        )
 
-    # eviction slots are unique by construction (rank < z within a
-    # bucket, disjoint slot ranges across buckets); unplaced rows drop
-    new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(
-        widx, mode="drop", unique_indices=True
-    )
-    new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(
-        wval, mode="drop", unique_indices=True
-    )
+        # eviction slots are unique by construction (rank < z within a
+        # bucket, disjoint slot ranges across buckets); unplaced rows drop
+        new_pidx = jnp.full((nslots,), SENTINEL, U32).at[slot_tgt].set(
+            widx, mode="drop", unique_indices=True
+        )
+        new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(
+            wval, mode="drop", unique_indices=True
+        )
 
-    # --- 4. stash recompaction + write-back ----------------------------
-    leftover = valid & ~placed
-    srank = rank_of(leftover)
-    starget = jnp.where(leftover, srank, s)  # OOB = dropped
-    stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(
-        widx, mode="drop", unique_indices=True
-    )
-    stash_val = jnp.zeros((s, v), U32).at[starget].set(
-        wval, mode="drop", unique_indices=True
-    )
-    n_left = jnp.sum(leftover.astype(jnp.int32))
-    stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
+        # --- 4. stash recompaction -------------------------------------
+        leftover = valid & ~placed
+        srank = rank_of(leftover)
+        starget = jnp.where(leftover, srank, s)  # OOB = dropped
+        stash_idx = jnp.full((s,), SENTINEL, U32).at[starget].set(
+            widx, mode="drop", unique_indices=True
+        )
+        stash_val = jnp.zeros((s, v), U32).at[starget].set(
+            wval, mode="drop", unique_indices=True
+        )
+        n_left = jnp.sum(leftover.astype(jnp.int32))
+        stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
 
     # owner expansion for the flat slot axis: each of a bucket's z slots
     # shares the bucket's owner bit
     fowner_slots = jnp.repeat(fowner, z)
     epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
-    if axis_name is None and fused and cfg.encrypted:
-        # single-chip fast path: encrypt + scatter in ONE HBM pass (the
-        # write-back mirror of the fused fetch; pallas_gather.py) —
-        # the nonce commit rides the same kernel, so this branch has no
-        # XLA scatter at all
-        from ..oblivious.pallas_gather import (
-            scatter_encrypt_rows,
-            scatter_encrypt_rows_tiled,
-        )
+    with device_phase("oram_writeback"):
+        if axis_name is None and fused and cfg.encrypted:
+            # single-chip fast path: encrypt + scatter in ONE HBM pass (the
+            # write-back mirror of the fused fetch; pallas_gather.py) —
+            # the nonce commit rides the same kernel, so this branch has no
+            # XLA scatter at all
+            from ..oblivious.pallas_gather import (
+                scatter_encrypt_rows,
+                scatter_encrypt_rows_tiled,
+            )
 
-        sc = (scatter_encrypt_rows_tiled
-              if cfg.cipher_impl == "pallas_fused_tiled"
-              else scatter_encrypt_rows)
-        tree_idx_new, tree_val_new, nonces = sc(
-            state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
-            flat_b, fowner, state.epoch,
-            new_pidx.reshape(b * plen, z),
-            new_pval.reshape(b * plen, z * v),
-            z=z, rounds=cfg.cipher_rounds,
-            interpret=jax.default_backend() not in _TPU_BACKENDS,
-        )
-    else:
-        enc_pidx, enc_pval = cipher_rows(
-            cfg,
-            state.cipher_key,
-            flat_b,
-            epochs_w,
-            new_pidx.reshape(b * plen, z),
-            new_pval.reshape(b * plen, z * v),
-        )
-        tree_idx_new = _path_scatter(
-            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name,
-            fowner_slots,
-        )
-        tree_val_new = _path_scatter(
-            state.tree_val, flat_b, enc_pval, axis_name, fowner
-        )
-        nonces = (
-            _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
-            if cfg.encrypted
-            else state.nonces
-        )
+            sc = (scatter_encrypt_rows_tiled
+                  if cfg.cipher_impl == "pallas_fused_tiled"
+                  else scatter_encrypt_rows)
+            tree_idx_new, tree_val_new, nonces = sc(
+                state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
+                flat_b, fowner, state.epoch,
+                new_pidx.reshape(b * plen, z),
+                new_pval.reshape(b * plen, z * v),
+                z=z, rounds=cfg.cipher_rounds,
+                interpret=jax.default_backend() not in _TPU_BACKENDS,
+            )
+        else:
+            enc_pidx, enc_pval = cipher_rows(
+                cfg,
+                state.cipher_key,
+                flat_b,
+                epochs_w,
+                new_pidx.reshape(b * plen, z),
+                new_pval.reshape(b * plen, z * v),
+            )
+            tree_idx_new = _path_scatter(
+                state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name,
+                fowner_slots,
+            )
+            tree_val_new = _path_scatter(
+                state.tree_val, flat_b, enc_pval, axis_name, fowner
+            )
+            nonces = (
+                _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
+                if cfg.encrypted
+                else state.nonces
+            )
     new_state = OramState(
         tree_idx=tree_idx_new,
         tree_val=tree_val_new,

@@ -9,14 +9,21 @@ jax + a device backend) — a client process never needs a device.
 """
 
 from .uri import GrapevineUri, SERVICE_NAME  # noqa: F401
-from .client import GrapevineClient  # noqa: F401
 
 __all__ = ["GrapevineUri", "SERVICE_NAME", "GrapevineClient", "GrapevineServer"]
 
 
 def __getattr__(name):
+    # GrapevineServer stays lazy so client processes never pull in the
+    # engine (jax + a device backend); GrapevineClient stays lazy so the
+    # scheduler/metrics path imports in containers without the
+    # `cryptography` wheel (session/__init__.py gates the channel layer)
     if name == "GrapevineServer":
         from .service import GrapevineServer
 
         return GrapevineServer
+    if name == "GrapevineClient":
+        from .client import GrapevineClient
+
+        return GrapevineClient
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -25,7 +25,6 @@ def _pin_platform() -> None:
 _pin_platform()
 
 from ..config import GrapevineConfig  # noqa: E402
-from .service import GrapevineServer  # noqa: E402
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -89,6 +88,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--engine",
         help="(role=frontend) host:port of the engine tier's Submit API",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve Prometheus /metrics and /healthz on this port "
+        "(0 = ephemeral; default: off). Telemetry is batch-level only — "
+        "the registry's leak audit guarantees nothing per-client or "
+        "per-op is exported (OPERATIONS.md §8) — but keep the port on "
+        "localhost or a private scrape network anyway",
+    )
+    p.add_argument(
+        "--metrics-host",
+        default="127.0.0.1",
+        help="interface for the metrics endpoint (default: localhost "
+        "only; point it at a private scrape interface explicitly — "
+        "operational telemetry is nobody else's business)",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -100,12 +116,14 @@ def build_parser() -> argparse.ArgumentParser:
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
-             "batch_wait_ms", "seed", "identity_seed", "verbose", "role"},
+             "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
+             "metrics_port", "metrics_host"},
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
-               "seed", "verbose", "role"},
+               "seed", "verbose", "role", "metrics_port", "metrics_host"},
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
-                 "batch_size", "identity_seed", "verbose", "role"},
+                 "batch_size", "identity_seed", "verbose", "role",
+                 "metrics_port", "metrics_host"},
 }
 
 
@@ -171,6 +189,10 @@ def main(argv=None) -> int:
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
+        if args.metrics_port is not None:
+            mport = engine.start_metrics(args.metrics_port,
+                                         host=args.metrics_host)
+            print(f"metrics endpoint on port {mport}", flush=True)
         try:
             threading.Event().wait()
         except KeyboardInterrupt:
@@ -185,6 +207,11 @@ def main(argv=None) -> int:
         server = FrontendServer(args.engine, config=config,
                                 identity=identity)
     else:
+        # imported here (not at module top) so role/flag validation and
+        # the engine role work in containers without the session layer's
+        # `cryptography` dependency
+        from .service import GrapevineServer
+
         server = GrapevineServer(
             config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
             identity=identity,
@@ -193,6 +220,9 @@ def main(argv=None) -> int:
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None
     port = server.start(args.listen, tls_cert=tls_cert, tls_key=tls_key)
     print(f"grapevine-tpu listening on port {port}", flush=True)
+    if args.metrics_port is not None:
+        mport = server.start_metrics(args.metrics_port, host=args.metrics_host)
+        print(f"metrics endpoint on port {mport}", flush=True)
     # the pinnable IX static (clients: GrapevineClient(server_static=...))
     print(f"server static key: {server.identity.public.hex()}", flush=True)
     try:

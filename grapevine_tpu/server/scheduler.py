@@ -49,9 +49,22 @@ class BatchScheduler:
         #: signature scheme module (sign/verify/batch_verify); default is
         #: the reference-compatible sr25519 (session/schnorrkel.py)
         self.scheme = scheme or schnorrkel
+        #: batch-level telemetry sink (engine/metrics.py on an
+        #: obs.TelemetryRegistry); the scheduler records into the
+        #: engine's registry so /metrics serves one merged view
+        self.metrics = getattr(engine, "metrics", None)
         self._queue: list[tuple[QueryRequest, AuthItem | None, Future]] = []
         self._inflight: list[Future] = []
         self._last_enqueue = 0.0
+        #: monotonic enqueue time of the current queue head — the age of
+        #: the oldest waiting op is the healthz stall signal (obs/httpd)
+        self._head_enqueue = 0.0
+        #: monotonic dispatch time of the round currently in flight on
+        #: the device, None when none is. A wedge inside resolve() (the
+        #: device never returning) empties the queue but freezes this —
+        #: stall_age() must see it, or healthz serves 200 while every
+        #: in-flight client hangs on fut.result() forever
+        self._inflight_since: float | None = None
         self._cv = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(target=self._run, daemon=True)
@@ -71,8 +84,32 @@ class BatchScheduler:
                 raise RuntimeError("scheduler closed")
             self._queue.append((req, auth, fut))
             self._last_enqueue = time.monotonic()
+            if len(self._queue) == 1:
+                self._head_enqueue = self._last_enqueue
+            if self.metrics is not None:
+                self.metrics.observe_queue_depth(len(self._queue))
             self._cv.notify()
         return fut.result()
+
+    # -- health probes (obs/httpd.py's /healthz) ------------------------
+
+    def worker_alive(self) -> bool:
+        """False once the collector thread has died (crash or close)."""
+        return self._worker.is_alive()
+
+    def stall_age(self) -> float:
+        """Seconds the oldest un-delivered op has been waiting: the max
+        of the queue head's wait and the in-flight round's age. A
+        healthy collector drains the head within max_wait + one device
+        round and settles an in-flight round promptly, so a growing
+        stall age means the engine thread has wedged — whether the ops
+        are still queued or already on the device (the healthz
+        trip-wire)."""
+        now = time.monotonic()
+        with self._cv:
+            q_age = now - self._head_enqueue if self._queue else 0.0
+        t = self._inflight_since  # benign unlocked float read
+        return max(q_age, now - t if t is not None else 0.0)
 
     def _run(self):
         """Collector loop wrapper: a crash in the loop must not strand
@@ -120,16 +157,33 @@ class BatchScheduler:
                     # commits after idle_gap. The wait runs while the
                     # device executes the previous round (see below), so
                     # it costs no device idle time under load.
-                    deadline = time.monotonic() + self.max_wait
+                    t_asm0 = time.monotonic()
+                    deadline = t_asm0 + self.max_wait
+                    hit_cap = False
                     while len(self._queue) < bs and not self._closed:
                         now = time.monotonic()
                         wait_until = min(
                             deadline, self._last_enqueue + self.idle_gap
                         )
                         if now >= wait_until:
+                            hit_cap = now >= deadline
                             break
                         self._cv.wait(timeout=wait_until - now)
                     chunk, self._queue = self._queue[:bs], self._queue[bs:]
+                    if self._queue:
+                        # remaining head has been waiting since roughly
+                        # now (it arrived during this window)
+                        self._head_enqueue = time.monotonic()
+                    if self.metrics is not None:
+                        self.metrics.observe_queue_depth(len(self._queue))
+                        self.metrics.observe_phase(
+                            "assembly", time.monotonic() - t_asm0
+                        )
+                        if hit_cap and len(chunk) < bs:
+                            # window closed by the max_wait cap, not by
+                            # quiescence or a full batch: arrivals are
+                            # starving mid-wave (the stall signal)
+                            self.metrics.record_stall()
 
             # everything the death-guard must fail if we crash from here:
             # the round still in flight on the device plus the chunk just
@@ -139,7 +193,11 @@ class BatchScheduler:
             ]
             pending, live = (None, [])
             if chunk:
-                live = self._verify_chunk(chunk)
+                if self.metrics is not None:
+                    with self.metrics.time_phase("verify"):
+                        live = self._verify_chunk(chunk)
+                else:
+                    live = self._verify_chunk(chunk)
                 if live:
                     reqs = [r for r, _ in live]
                     try:
@@ -149,6 +207,7 @@ class BatchScheduler:
                         pending = self.engine.handle_queries_async(
                             reqs, self.clock()
                         )
+                        self._inflight_since = time.monotonic()
                     except Exception as exc:  # pragma: no cover - defensive
                         for _, fut in live:
                             if not fut.done():
@@ -156,6 +215,9 @@ class BatchScheduler:
                         live = []
             if prev is not None:
                 self._settle(*prev)
+            if pending is None:
+                # nothing left on the device (prev, if any, just settled)
+                self._inflight_since = None
             prev = (pending, live) if pending is not None else None
 
     def _verify_chunk(self, chunk):
@@ -187,8 +249,8 @@ class BatchScheduler:
                         [chunk[i][1] for i in half]
                     ):
                         stack.append(half)
-        if authed:
-            self.engine.metrics.record_auth(failures=len(rejected))
+        if authed and self.metrics is not None:
+            self.metrics.record_auth(failures=len(rejected))
         return [
             (req, fut)
             for i, (req, _, fut) in enumerate(chunk)

@@ -117,6 +117,20 @@ class GrapevineServer:
         self._expiry_stop = threading.Event()
         self._expiry_thread: threading.Thread | None = None
         self.clock = clock or (lambda: int(time.time()))
+        #: one merged telemetry namespace: the engine's registry when we
+        #: own a device engine, a standalone one in the injected-
+        #: scheduler (frontend) role — either way /metrics serves engine
+        #: + scheduler + session telemetry from a single registry
+        if self.engine is not None:
+            self.metrics_registry = self.engine.metrics.registry
+        else:
+            from ..obs import TelemetryRegistry
+
+            self.metrics_registry = TelemetryRegistry()
+        self._g_sessions = self.metrics_registry.gauge(
+            "grapevine_sessions", "live authenticated sessions"
+        )
+        self._metrics_server = None
 
     # -- RPC handlers (raw-bytes serializers) ---------------------------
 
@@ -137,6 +151,7 @@ class GrapevineServer:
         with self._sessions_lock:
             self._evict_sessions_locked()
             self._sessions[token] = _Session(secure_channel, seed)
+            self._g_sessions.set(len(self._sessions))
         return pw.encode_auth_with_seed(
             pw.AuthMessageWithChallengeSeed(
                 auth_message=pw.AuthMessage(data=reply),
@@ -171,6 +186,7 @@ class GrapevineServer:
                 and now - session.last_used > self.session_ttl
             ):
                 del self._sessions[envelope.channel_id]
+                self._g_sessions.set(len(self._sessions))
                 session = None
         if session is None:
             context.abort(grpc.StatusCode.UNAUTHENTICATED, "unknown channel")
@@ -259,11 +275,63 @@ class GrapevineServer:
         return port
 
     def health(self) -> dict:
-        """Aggregate metrics (SURVEY §5: never keyed by client identity)."""
+        """Aggregate metrics (SURVEY §5: never keyed by client identity).
+
+        One merged view: engine counters, scheduler/queue gauges, phase
+        histograms, and ORAM stash telemetry all come from the shared
+        obs registry (engine/metrics.py), so a loopback client sees the
+        same picture /metrics exports — not just the engine snapshot.
+        """
         with self._sessions_lock:
             n_sessions = len(self._sessions)
-        engine_health = self.engine.health() if self.engine is not None else {}
-        return {"sessions": n_sessions, **engine_health}
+        if self.engine is not None:
+            detail = self.engine.health()
+        else:
+            # frontend role: no device engine in-process; the registry
+            # still carries the session gauge (engine telemetry lives on
+            # the engine tier's own endpoint)
+            detail = self.metrics_registry.snapshot()
+        return {"sessions": n_sessions, **detail}
+
+    def healthz(self, stall_threshold: float = 30.0) -> tuple[bool, dict]:
+        """Liveness verdict for the /healthz endpoint (obs/httpd.py).
+
+        Unhealthy when the scheduler's collector thread has died or its
+        oldest queued op has waited past ``stall_threshold`` (the engine
+        wedged mid-round); an idle server with an empty queue is healthy
+        no matter how long ago the last round committed. Lock-light by
+        design — this must answer while a stuck round holds the engine
+        lock."""
+        healthy = True
+        detail: dict = {}
+        sched = self.scheduler
+        if hasattr(sched, "worker_alive"):  # injected stubs may lack it
+            alive = sched.worker_alive()
+            stall = sched.stall_age()
+            detail["worker_alive"] = alive
+            detail["stall_age_s"] = round(stall, 3)
+            healthy = alive and stall < stall_threshold
+        if self.engine is not None:
+            age = self.engine.metrics.last_round_age()
+            detail["last_round_age_s"] = None if age is None else round(age, 3)
+        return healthy, detail
+
+    def start_metrics(self, port: int, host: str = "127.0.0.1",
+                      stall_threshold: float = 30.0) -> int:
+        """Serve /metrics + /healthz on ``host:port``; returns the bound
+        port (pass 0 for an ephemeral one). Off unless called — the CLI
+        wires ``--metrics-port`` here."""
+        from ..obs import MetricsServer
+
+        self._metrics_server = MetricsServer(
+            self.metrics_registry,
+            health=lambda: self.healthz(stall_threshold),
+            refresh=(self.engine.sample_stash if self.engine is not None
+                     else None),
+            host=host,
+            port=port,
+        )
+        return self._metrics_server.start()
 
     def _expiry_loop(self):
         run_expiry_loop(self.engine, self.config, self._expiry_stop,
@@ -271,6 +339,9 @@ class GrapevineServer:
 
     def stop(self, grace: float = 1.0):
         self._expiry_stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
         self.scheduler.close()

@@ -83,6 +83,7 @@ class EngineServer:
         self.clock = clock or (lambda: int(_time.time()))
         self._expiry_stop = threading.Event()
         self._expiry_thread: threading.Thread | None = None
+        self._metrics_server = None
 
     def _submit(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
         if len(request_bytes) != C.QUERY_REQUEST_WIRE_SIZE + C.CHALLENGE_SIZE:
@@ -147,8 +148,41 @@ class EngineServer:
     def health(self) -> dict:
         return self.engine.health()
 
+    def healthz(self, stall_threshold: float = 30.0) -> tuple[bool, dict]:
+        """Engine-tier liveness: collector thread up, oldest queued op
+        not waiting past the threshold (same semantics as the monolithic
+        server's healthz, server/service.py)."""
+        alive = self.scheduler.worker_alive()
+        stall = self.scheduler.stall_age()
+        age = self.engine.metrics.last_round_age()
+        return alive and stall < stall_threshold, {
+            "worker_alive": alive,
+            "stall_age_s": round(stall, 3),
+            "last_round_age_s": None if age is None else round(age, 3),
+        }
+
+    def start_metrics(self, port: int, host: str = "127.0.0.1",
+                      stall_threshold: float = 30.0) -> int:
+        """Serve /metrics + /healthz for the engine tier; returns the
+        bound port. The engine tier owns the device, so it owns the
+        batch/round/stash telemetry — frontends export only their own
+        session-layer registry."""
+        from ..obs import MetricsServer
+
+        self._metrics_server = MetricsServer(
+            self.engine.metrics.registry,
+            health=lambda: self.healthz(stall_threshold),
+            refresh=self.engine.sample_stash,
+            host=host,
+            port=port,
+        )
+        return self._metrics_server.start()
+
     def stop(self, grace: float = 1.0):
         self._expiry_stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
         self.scheduler.close()
@@ -221,6 +255,12 @@ class FrontendServer:
 
     def health(self) -> dict:
         return self._inner.health()
+
+    def start_metrics(self, port: int, host: str = "127.0.0.1",
+                      stall_threshold: float = 30.0) -> int:
+        # the frontend's registry carries session-layer telemetry only;
+        # round/stash metrics live on the engine tier's endpoint
+        return self._inner.start_metrics(port, host, stall_threshold)
 
     def wait(self):
         self._inner.wait()

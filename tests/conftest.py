@@ -22,10 +22,66 @@ if "xla_force_host_platform_device_count" not in _flags:
 # the default terminate timeout SIGABRTs spuriously at larger test
 # shapes (BIGRUN_r5.md — a flag, not a scale wall). Guard each flag by
 # its own name so ambient values are never overridden by a late append.
-if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+# Older jaxlibs hard-abort (CHECK-fail) on *unknown* XLA flags, which
+# would kill the whole test session at backend init — probe once in a
+# subprocess and only add the flags this jaxlib actually parses. One
+# combined probe covers the common case (all supported or none: the two
+# flags shipped in the same jaxlib release), and the verdict is cached
+# per jaxlib version so the cold jax subprocess start is paid once per
+# environment, not once per pytest session.
+def _xla_flags_supported(flags: str) -> bool:
+    import hashlib
+    import subprocess
+    import sys
+    import tempfile
+
+    import jaxlib
+
+    tag = hashlib.sha256(
+        f"{jaxlib.__version__}:{flags}".encode()
+    ).hexdigest()[:16]
+    cache = os.path.join(
+        tempfile.gettempdir(), f"grapevine_xla_flag_probe_{tag}"
+    )
+    try:
+        with open(cache) as fh:
+            return fh.read().strip() == "ok"
+    except OSError:
+        pass
+    probe = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'; "
+        f"os.environ['XLA_FLAGS']={flags!r}; "
+        "import jax; jax.devices()"
+    )
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False  # don't cache a flaky probe run
+    try:
+        with open(cache, "w") as fh:
+            fh.write("ok" if ok else "unsupported")
+    except OSError:
+        pass
+    return ok
+
+
+_timeout_flags = [
+    f
+    for f in (
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+        "--xla_cpu_collective_call_terminate_timeout_seconds=600",
+    )
+    if f.split("=")[0].lstrip("-") not in _flags
+]
+if _timeout_flags and _xla_flags_supported(" ".join(_timeout_flags)):
+    _flags += " " + " ".join(_timeout_flags)
 os.environ["XLA_FLAGS"] = _flags
 
 # The env var alone is not enough: plugin site hooks (e.g. the axon PJRT

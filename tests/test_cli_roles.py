@@ -38,6 +38,12 @@ def test_misapplied_flags_rejected(argv):
      "--msg-capacity", "512", "--batch-size", "16", "--seed", "3"],
     ["--role", "frontend", "--engine", "127.0.0.1:4000",
      "--listen", "insecure-grapevine://0.0.0.0:1", "--batch-size", "16"],
+    # the metrics endpoint is a per-process concern: every role takes it
+    ["--metrics-port", "9464"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--metrics-port", "9464"],
+    ["--role", "frontend", "--engine", "127.0.0.1:4000",
+     "--metrics-port", "0"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

@@ -44,6 +44,66 @@ def test_metrics_ring_and_percentiles():
     assert s["round_ms_p99"] <= 20.1
 
 
+def test_concurrent_recording_is_lossless():
+    """Hammer every recording entry point from N threads: counter totals
+    must be exact and the ring consistent — record_round runs outside
+    the engine lock in production (PendingRound.resolve), so the
+    internal locks are the only thing between us and lost samples."""
+    import threading
+
+    m = EngineMetrics(ring_size=64)
+    n_threads, per = 8, 250
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        barrier.wait()  # maximize interleaving
+        for i in range(per):
+            m.record_round(n_real=1, batch_size=2, seconds=0.002)
+            m.record_auth(failures=1)
+            m.observe_stash(i % 50)
+            m.observe_phase("verify", 0.0005)
+            m.observe_queue_depth(i % 7)
+            m.record_sweep(2)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    total = n_threads * per
+    s = m.snapshot()
+    assert s["rounds"] == total
+    assert s["real_ops"] == total
+    assert s["batch_occupancy"] == 0.5
+    assert s["batch_verifies"] == total and s["auth_failures"] == total
+    assert s["sweeps"] == total and s["evicted"] == 2 * total
+    assert s["stash_high_water"] == 49
+    assert s["queue_depth_high_water"] == 6
+    # ring integrity: every committed sample is a real write (all equal
+    # here, so any interleaving must yield exactly 2ms at any quantile)
+    assert s["round_ms_p50"] == 2.0 and s["round_ms_p99"] == 2.0
+    # histogram totals are exact too
+    assert s["grapevine_phase_seconds{phase=verify}_count"] == total
+    assert s["grapevine_stash_occupancy_count"] == total
+    # and the hammered registry still audits clean
+    assert m.registry.audit()["ok"]
+
+
+def test_small_sample_percentiles_do_not_underreport():
+    """Satellite fix: linear interpolation under-reported p99 on a
+    partially-filled ring (at 20 rounds it blended the 19th and 20th
+    samples). method="higher" returns a real order statistic."""
+    m = EngineMetrics(ring_size=1024)
+    for i in range(20):
+        m.record_round(n_real=1, batch_size=1, seconds=0.001 * (i + 1))
+    s = m.snapshot()
+    # p99 of 20 samples must be the largest sample, not an interpolation
+    assert s["round_ms_p99"] == 20.0
+    assert s["round_ms_p50"] == 11.0  # ceil order statistic, never below
+
+
 def test_engine_health_includes_batch_metrics():
     cfg = GrapevineConfig(
         bucket_cipher_rounds=0,

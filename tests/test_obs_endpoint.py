@@ -1,0 +1,134 @@
+"""The /metrics + /healthz endpoint (obs/httpd.py) over a live engine
+tier: Prometheus exposition includes every round phase, and healthz
+flips unhealthy when the engine thread stalls or dies.
+
+Uses the engine tier (server/tier.py EngineServer) rather than the
+monolithic server: the endpoint machinery is identical (both route
+through start_metrics → obs.MetricsServer), and the engine tier imports
+without the session layer's `cryptography` dependency.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.server.tier import EngineServer
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+
+def _req(rt, auth, recipient=C.ZERO_PUBKEY):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=C.ZERO_MSG_ID,
+            recipient=recipient,
+            payload=b"\x07" * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:  # 503 still carries a body
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def tier():
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=64,
+        max_recipients=16,
+        mailbox_cap=4,
+        batch_size=4,
+        stash_size=96,
+        expiry_period=10,
+    )
+    srv = EngineServer(cfg, seed=7, max_wait_ms=5.0, clock=lambda: NOW)
+    port = srv.start_metrics(0, host="127.0.0.1")
+    yield srv, port
+    srv.stop()
+
+
+def test_metrics_endpoint_serves_phase_histograms(tier):
+    srv, port = tier
+    # one authenticated-less round through the real scheduler + engine,
+    # plus one expiry sweep, so every phase series has samples
+    resp = srv.scheduler.submit(
+        _req(C.REQUEST_TYPE_CREATE, bytes([1]) * 32, recipient=bytes([2]) * 32)
+    )
+    assert resp.status_code == C.STATUS_CODE_SUCCESS
+    srv.engine.expire(NOW + 100)
+
+    status, text = _get(f"http://127.0.0.1:{port}/metrics")
+    assert status == 200
+    # per-phase round histograms (the acceptance set), with samples in
+    # the phases this round exercised
+    for phase in ("assembly", "verify", "dispatch", "evict", "demux", "sweep"):
+        assert f'grapevine_phase_seconds_bucket{{phase="{phase}",le=' in text
+    for phase in ("assembly", "dispatch", "evict", "demux", "sweep"):
+        assert f'grapevine_phase_seconds_count{{phase="{phase}"}} 0' not in text
+    assert "grapevine_rounds_total 1" in text
+    assert "grapevine_batch_occupancy 0.25" in text  # 1 real op, B=4
+    assert "grapevine_underfull_rounds_total 1" in text
+    assert "grapevine_queue_depth " in text
+    assert "grapevine_queue_depth_high_water 1" in text
+    # the pre-scrape refresh hook sampled the stash (device sync)
+    assert "grapevine_stash_high_water" in text
+    assert "grapevine_stash_occupancy_count" in text
+    assert "grapevine_expiry_sweeps_total 1" in text
+
+
+def test_merged_health_view_includes_scheduler_and_oram(tier):
+    """Satellite: the loopback health dict carries engine counters,
+    scheduler gauges, and ORAM stash telemetry in one merged view."""
+    srv, _ = tier
+    h = srv.health()
+    assert "rounds" in h and "messages" in h  # engine
+    assert "queue_depth_high_water" in h and "collector_stalls" in h  # sched
+    assert "stash_high_water" in h  # ORAM
+    assert 'grapevine_phase_seconds{phase=dispatch}_count' in h  # registry
+
+
+def test_healthz_healthy_then_flips_on_stall_and_death(tier):
+    srv, port = tier
+    status, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 200 and json.loads(body)["healthy"] is True
+
+    # a wedged engine: the oldest queued op waits past the threshold
+    real_stall_age = srv.scheduler.stall_age
+    srv.scheduler.stall_age = lambda: 1e9
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 503 and json.loads(body)["healthy"] is False
+    finally:
+        srv.scheduler.stall_age = real_stall_age
+
+    status, _ = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 200
+
+    # a dead collector thread is unhealthy regardless of queue state
+    srv.scheduler.close()
+    deadline = time.monotonic() + 10
+    while srv.scheduler.worker_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    status, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 503
+    assert json.loads(body)["worker_alive"] is False
+
+
+def test_unknown_path_404(tier):
+    _, port = tier
+    status, _ = _get(f"http://127.0.0.1:{port}/nope")
+    assert status == 404

@@ -98,6 +98,47 @@ def test_stalled_arrivals_close_the_round():
         sched.close()
 
 
+def test_stall_age_sees_wedged_inflight_round():
+    """A round wedged on the device empties the queue — stall_age()
+    must age the in-flight round, or /healthz serves 200 while every
+    blocked client hangs on fut.result() forever."""
+    unwedge = threading.Event()
+
+    class _WedgedEngine(_StubEngine):
+        def handle_queries_async(self, reqs, now):
+            resps = self.handle_queries(reqs, now)
+
+            class _Pending:
+                def resolve(self):
+                    unwedge.wait(timeout=30)  # the wedge
+                    return resps
+
+            return _Pending()
+
+    eng = _WedgedEngine()
+    sched = BatchScheduler(eng, max_wait_ms=50.0, idle_gap_ms=10.0)
+    try:
+        assert sched.stall_age() == 0.0  # idle: no queue, nothing in flight
+        t = threading.Thread(target=sched.submit, args=(_req(),))
+        t.start()
+        # the op leaves the queue (dispatched) but never resolves; the
+        # stall signal must keep growing with an empty queue
+        deadline = time.monotonic() + 10
+        while sched.stall_age() < 0.2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sched.stall_age() >= 0.2, "wedged in-flight round invisible"
+        assert sched.worker_alive()
+        unwedge.set()
+        t.join(timeout=10)
+        deadline = time.monotonic() + 10
+        while sched.stall_age() > 0.0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sched.stall_age() == 0.0  # settled: signal clears
+    finally:
+        unwedge.set()
+        sched.close()
+
+
 def test_full_batch_commits_without_waiting():
     eng = _StubEngine()
     sched = BatchScheduler(eng, max_wait_ms=10_000.0, idle_gap_ms=10_000.0)

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Telemetry leak-policy checker (CI gate; invoked by a tier-1 test).
+
+Two passes, mirroring how testing/leakcheck.py checks the transcript:
+
+1. **Static scan** — grep every instrumentation call site under
+   ``grapevine_tpu/`` for forbidden label keys (per-client / per-op
+   dimensions). A kwarg like ``op_type=`` on a ``labels()``/``inc()``/
+   ``observe()`` call, or a forbidden key inside a ``labels={...}``
+   registration, fails the check with file:line — before the code ever
+   runs.
+2. **Registry audit** — instantiate the shipped registry (the one
+   ``EngineMetrics`` builds, i.e. exactly what /metrics exports) and run
+   ``TelemetryRegistry.audit()``: every label key must be allowlisted,
+   every series declared, every histogram's buckets fixed.
+
+Exit 0 = policy holds; exit 1 = a violation, printed with its location.
+
+Run directly::
+
+    python tools/check_telemetry_policy.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "grapevine_tpu")
+
+#: must match obs.registry.FORBIDDEN_LABEL_KEYS (imported below for the
+#: audit pass; duplicated here only to build the static regex without
+#: importing before the scan)
+_FORBIDDEN = (
+    "client", "client_id", "session", "session_id", "channel",
+    "channel_id", "user", "user_id", "identity", "auth", "auth_identity",
+    "msg_id", "message_id", "sender", "recipient", "key", "block",
+    "leaf", "path", "op", "op_type", "operation", "request_type",
+)
+
+#: telemetry call sites: sample calls with label kwargs, and
+#: registration calls with a labels= declaration
+_CALL_RE = re.compile(
+    r"\.(?:labels|inc|observe|set|set_max|counter|gauge|histogram)\("
+)
+_KWARG_RES = [
+    (k, re.compile(rf"[(,]\s*{k}\s*=")) for k in _FORBIDDEN
+]
+_DECL_RES = [
+    (k, re.compile(rf"""labels\s*=\s*\{{[^}}]*['"]{k}['"]""")) for k in _FORBIDDEN
+]
+
+
+def _call_site_spans(text: str):
+    """Yield (lineno, span_text) for each telemetry call, where span_text
+    covers the call through its closing paren (label kwargs may sit on
+    continuation lines)."""
+    for m in _CALL_RE.finditer(text):
+        start = m.end() - 1  # the opening paren
+        depth = 0
+        end = start
+        for i in range(start, min(len(text), start + 2000)):
+            c = text[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i + 1
+                    break
+        yield text.count("\n", 0, m.start()) + 1, text[m.start():end]
+
+
+def scan_call_sites() -> list[str]:
+    """Static pass: forbidden label keys at instrumentation call sites."""
+    violations = []
+    for dirpath, _, names in os.walk(PKG):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            for lineno, span in _call_site_spans(text):
+                for key, rx in _KWARG_RES:
+                    if rx.search(span):
+                        violations.append(
+                            f"{rel}:{lineno}: telemetry call passes "
+                            f"forbidden label key {key!r}"
+                        )
+            for key, rx in _DECL_RES:
+                for m in rx.finditer(text):
+                    lineno = text.count("\n", 0, m.start()) + 1
+                    violations.append(
+                        f"{rel}:{lineno}: metric registration declares "
+                        f"forbidden label key {key!r}"
+                    )
+    return violations
+
+
+def audit_shipped_registry() -> dict:
+    """Runtime pass: the registry EngineMetrics ships must pass audit."""
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.engine.metrics import EngineMetrics
+    from grapevine_tpu.obs.registry import FORBIDDEN_LABEL_KEYS
+
+    missing = set(_FORBIDDEN) - set(FORBIDDEN_LABEL_KEYS)
+    if missing:
+        raise SystemExit(
+            f"checker's forbidden-key list drifted from obs.registry: "
+            f"{sorted(missing)} not in FORBIDDEN_LABEL_KEYS"
+        )
+    return EngineMetrics().registry.audit()
+
+
+def main() -> int:
+    violations = scan_call_sites()
+    for v in violations:
+        print(f"TELEMETRY POLICY VIOLATION: {v}", file=sys.stderr)
+    report = audit_shipped_registry()
+    print(
+        f"telemetry policy: static scan "
+        f"{'FAILED' if violations else 'clean'}; registry audit ok "
+        f"({report['metrics']} metrics, {report['series']} series)"
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
