@@ -486,7 +486,13 @@ def bench_server_loopback(smoke):
         batch_size=16,
         bucket_cipher_rounds=0 if smoke else 8,
     )
-    server = GrapevineServer(config=cfg)
+    # the leak monitor rides every loopback round (ISSUE 2 acceptance:
+    # p99 must hold within 3% with it on — the hand-off is one queue
+    # put; detectors run on the monitor's own thread). Its verdict is
+    # reported so the bench doubles as an honest-soak audit.
+    from grapevine_tpu.obs.leakmon import LeakMonitorConfig
+
+    server = GrapevineServer(config=cfg, leakmon=LeakMonitorConfig())
     port = server.start("insecure-grapevine://127.0.0.1:0")
     try:
         clients = [
@@ -539,12 +545,16 @@ def bench_server_loopback(smoke):
             for k, v in server.metrics_registry.snapshot().items()
             if k.startswith("grapevine_phase_seconds{") and k.endswith("_p99")
         }
+        server.leakmon.flush(10)
+        audit = server.leakmon.verdict()
         return {
             "ops_per_sec": round(ops / total, 1),
             "p99_pair_ms": round(_p99(lat), 2),
             "phase_p99_s": phases,
             "clients": n_clients,
             "capacity_log2": cap.bit_length() - 1,
+            "leakaudit": audit["verdict"],
+            "leakaudit_rounds": audit["rounds_observed"],
         }
     finally:
         server.stop()
@@ -637,6 +647,39 @@ def _emit(results, meta):
     line.update(meta)
     sys.stdout.write(json.dumps(line) + "\n")
     sys.stdout.flush()
+    return line
+
+
+def _pr_tag() -> str:
+    """The PR tag for the trajectory line: ``--pr TAG`` (or ``--pr=TAG``)
+    on the command line, else $GRAPEVINE_PR, else empty."""
+    import os
+
+    argv = sys.argv[1:]
+    for i, tok in enumerate(argv):
+        if tok == "--pr" and i + 1 < len(argv):
+            return argv[i + 1]
+        if tok.startswith("--pr="):
+            return tok[len("--pr="):]
+    return os.environ.get("GRAPEVINE_PR", "")
+
+
+def _append_trajectory(line: dict, tag: str) -> None:
+    """Append the final result line to BENCH_trajectory.jsonl next to
+    this file, so the perf trajectory accumulates across PRs instead of
+    living only in per-run artifacts. Best-effort: a read-only checkout
+    must not fail the bench itself."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_trajectory.jsonl"
+    )
+    entry = {"ts": int(time.time()), "pr": tag, **line}
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        print(f"[bench] trajectory append failed: {e}", file=sys.stderr)
 
 
 def main():
@@ -714,10 +757,13 @@ def main():
         print(f"[bench] {name}: {results[name]} ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr, flush=True)
         _emit(results, meta)
+    line = _emit(results, meta)
+    # trajectory first, assert after: a failed config must still leave
+    # its line in the cross-PR record (the artifact tells the story)
+    _append_trajectory(line, _pr_tag())
     if strict_smoke:
         for name, r in results.items():
             assert "error" not in r, f"{name} failed in smoke mode: {r}"
-    _emit(results, meta)
 
 
 if __name__ == "__main__":

@@ -118,13 +118,22 @@ def unpack_responses(resp: dict, n: int) -> list[QueryResponse]:
 class PendingRound:
     """Handle to a dispatched-but-unsynced round; ``resolve()`` blocks."""
 
-    __slots__ = ("_engine", "_resp", "_n", "_t0")
+    __slots__ = ("_engine", "_resp", "_n", "_t0", "_transcript", "_batch",
+                 "_phases")
 
-    def __init__(self, engine, resp, n, t0):
+    def __init__(self, engine, resp, n, t0, transcript=None, batch=None,
+                 phases=None):
         self._engine = engine
         self._resp = resp
         self._n = n
         self._t0 = t0
+        #: leak-monitor hand-off (engine.leakmon set): the round's public
+        #: transcript (still a device array — the copy happens on the
+        #: monitor thread) plus the host-side batch dict its key groups
+        #: derive from, and the per-round phase durations so far
+        self._transcript = transcript
+        self._batch = batch
+        self._phases = phases
 
     def resolve(self) -> list[QueryResponse]:
         m = self._engine.metrics
@@ -132,17 +141,29 @@ class PendingRound:
         # jit'd fetch/apply/evict/write-back program finishes inside this
         # wait (per-stage device splits live in the profiler trace via
         # jax.named_scope — the host cannot time inside one XLA program)
+        t_ev = time.perf_counter()
         with m.time_phase("evict"):
             jax.block_until_ready(self._resp)
+        t_dm = time.perf_counter()
         with m.time_phase("demux"):
             out = unpack_responses(self._resp, self._n)
+        t_done = time.perf_counter()
         # recorded duration = dispatch → results delivered. Under the
         # pipelined scheduler this includes the next round's collection
         # window (resolve runs after the next dispatch), i.e. it is the
         # round *commit latency* a client observes, not pure device time
-        m.record_round(
-            self._n, self._engine.ecfg.batch_size, time.perf_counter() - self._t0
-        )
+        bs = self._engine.ecfg.batch_size
+        m.record_round(self._n, bs, t_done - self._t0)
+        lm = self._engine.leakmon
+        if lm is not None and self._transcript is not None:
+            # one non-blocking queue put; detectors run on the monitor's
+            # own thread (obs/leakmon.py), never on the round path
+            phases = dict(self._phases or {})
+            phases["evict"] = t_dm - t_ev
+            phases["demux"] = t_done - t_dm
+            phases["round"] = t_done - self._t0
+            lm.submit_round(self._batch, self._transcript, self._n, bs,
+                            phases)
         return out
 
 
@@ -168,6 +189,14 @@ class GrapevineEngine:
         )
         self._lock = threading.Lock()
         self.metrics = EngineMetrics()
+        #: streaming obliviousness auditor (obs/leakmon.py), attached by
+        #: the serving layer when --leakmon is on; None = no monitoring
+        self.leakmon = None
+
+    def attach_leakmon(self, monitor) -> None:
+        """Attach an EngineLeakMonitor; subsequent rounds hand their
+        transcripts to it off the jit path (PendingRound.resolve)."""
+        self.leakmon = monitor
 
     def handle_queries(
         self, reqs: list[QueryRequest], now: int
@@ -207,14 +236,31 @@ class GrapevineEngine:
         bs = self.ecfg.batch_size
         if len(reqs) > bs:
             raise ValueError("async path is one round at a time")
+        lm = self.leakmon
         with self._lock:
             # "dispatch" = host pack + async device enqueue (JAX returns
             # at enqueue; the device round itself lands in "evict")
+            t_d0 = time.perf_counter()
             with self.metrics.time_phase("dispatch"):
                 batch = pack_batch(reqs, bs, now)
                 t0 = time.perf_counter()
-                self.state, resp, _ = self._step(self.ecfg, self.state, batch)
-        return PendingRound(self, resp, len(reqs), t0)
+                self.state, resp, transcript = self._step(
+                    self.ecfg, self.state, batch
+                )
+            dispatch_s = time.perf_counter() - t_d0
+        if lm is None:
+            return PendingRound(self, resp, len(reqs), t0)
+        # hand the monitor only the key-material columns: retaining the
+        # full batch dict would pin the (B, PAYLOAD_WORDS) payload array
+        # in the monitor queue for grouping that never reads it
+        key_cols = {
+            k: batch[k] for k in ("req_type", "auth", "msg_id", "recipient")
+        }
+        return PendingRound(
+            self, resp, len(reqs), t0,
+            transcript=transcript, batch=key_cols,
+            phases={"dispatch": dispatch_s},
+        )
 
     def handle_queries_with_transcript(self, reqs, now):
         """Test/bench variant returning the public transcript as well."""

@@ -17,8 +17,14 @@ leak policy structurally rather than by convention:
   feeding the registry, and ``jax`` trace annotations for TPU profiler
   runs;
 - ``exporter``: Prometheus text exposition of a registry;
-- ``httpd``: a stdlib ``http.server`` thread serving ``/metrics`` and
-  ``/healthz``.
+- ``httpd``: a stdlib ``http.server`` thread serving ``/metrics``,
+  ``/healthz``, ``/leakaudit``, and ``/flightrec``;
+- ``leakmon``: the streaming transcript leak monitor — the pytest
+  detectors (testing/leakcheck.py) run continuously over a sliding
+  window of production rounds, publishing aggregate-only statistics
+  and a machine-readable PASS/SUSPECT verdict;
+- ``flightrec``: a fixed-size ring of schema-checked per-round
+  summaries, dumped on demand or on a PASS→SUSPECT transition.
 """
 
 from .registry import (  # noqa: F401
@@ -33,3 +39,9 @@ from .registry import (  # noqa: F401
 from .phases import PHASES, device_phase, phase_timer  # noqa: F401
 from .exporter import render_prometheus  # noqa: F401
 from .httpd import MetricsServer  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
+from .leakmon import (  # noqa: F401
+    EngineLeakMonitor,
+    LeakMonitorConfig,
+    TranscriptLeakMonitor,
+)

@@ -20,16 +20,37 @@ def _fmt_value(v: float) -> str:
     return repr(int(f)) if f == int(f) else repr(f)
 
 
+def _escape_help(text: str) -> str:
+    """# HELP escaping per the 0.0.4 text format: backslash and line
+    feed (a raw newline would terminate the comment mid-text and turn
+    the remainder into an unparseable sample line)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    """Label-value escaping per the 0.0.4 text format: backslash,
+    double-quote, and line feed. Label values are registration-declared
+    (obs/registry.py), so this is belt-and-braces — but a declared value
+    containing a quote must still scrape clean, not corrupt the series
+    name for every metric after it."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _labelstr(keys, vals, extra=()) -> str:
-    pairs = [f'{k}="{v}"' for k, v in zip(keys, vals)]
-    pairs += [f'{k}="{v}"' for k, v in extra]
+    pairs = [f'{k}="{_escape_label_value(v)}"' for k, v in zip(keys, vals)]
+    pairs += [f'{k}="{_escape_label_value(v)}"' for k, v in extra]
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
 def render_prometheus(registry: TelemetryRegistry) -> str:
     lines: list[str] = []
     for m in registry.collect():
-        lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         for vals, child in m.series():
             if m.kind == "histogram":

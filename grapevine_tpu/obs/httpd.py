@@ -1,4 +1,5 @@
-"""The /metrics + /healthz endpoint: a stdlib http.server thread.
+"""The /metrics + /healthz (+ /leakaudit, /flightrec) endpoint: a
+stdlib http.server thread.
 
 Deliberately not a gRPC method on the public service: scrapers and
 load-balancer health checks speak plain HTTP, and the endpoint must stay
@@ -33,6 +34,13 @@ class MetricsServer:
     detail: dict)``; unhealthy renders HTTP 503 so any LB/probe flips
     without parsing the body. The callable runs on the scrape thread —
     it must not take engine locks that a wedged round could hold.
+
+    ``leakaudit`` is a zero-arg callable returning the leak monitor's
+    machine-readable verdict dict (obs/leakmon.py) — served on
+    ``/leakaudit`` as JSON, HTTP 200 on PASS and 503 on SUSPECT so a
+    probe can alert without parsing. ``flightrec`` is a zero-arg
+    callable returning the flight recorder dump dict (obs/flightrec.py)
+    — served on ``/flightrec``. Both 404 when not configured.
     """
 
     def __init__(
@@ -42,9 +50,13 @@ class MetricsServer:
         refresh=None,
         host: str = "127.0.0.1",
         port: int = 9464,
+        leakaudit=None,
+        flightrec=None,
     ):
         self.registry = registry
         self.health = health or (lambda: (True, {}))
+        self.leakaudit = leakaudit
+        self.flightrec = flightrec
         #: optional zero-arg pre-scrape hook: sample pull-style gauges
         #: (stash occupancy needs a device sync, which must happen at
         #: scrape cadence, not round cadence). Runs only for /metrics —
@@ -92,6 +104,26 @@ class MetricsServer:
                     self._reply(
                         200 if healthy else 503, body, "application/json"
                     )
+                elif path == "/leakaudit" and outer.leakaudit is not None:
+                    try:
+                        verdict = outer.leakaudit()
+                    except Exception as exc:  # a broken audit is suspect
+                        verdict = {"verdict": "SUSPECT",
+                                   "error": repr(exc)}
+                    body = json.dumps(verdict).encode()
+                    self._reply(
+                        200 if verdict.get("verdict") == "PASS" else 503,
+                        body, "application/json",
+                    )
+                elif path == "/flightrec" and outer.flightrec is not None:
+                    try:
+                        dump = outer.flightrec()
+                    except Exception as exc:
+                        self._reply(500, repr(exc).encode(), "text/plain")
+                        return
+                    self._reply(
+                        200, json.dumps(dump).encode(), "application/json"
+                    )
                 else:
                     self._reply(404, b"not found\n", "text/plain")
 
@@ -103,8 +135,9 @@ class MetricsServer:
         )
         self._thread.start()
         port = self._httpd.server_address[1]
-        log.info("metrics endpoint on %s:%d (/metrics, /healthz)",
-                 self._host, port)
+        log.info("metrics endpoint on %s:%d (/metrics, /healthz%s)",
+                 self._host, port,
+                 ", /leakaudit, /flightrec" if self.leakaudit else "")
         return port
 
     @property

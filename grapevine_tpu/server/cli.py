@@ -105,6 +105,51 @@ def build_parser() -> argparse.ArgumentParser:
         "only; point it at a private scrape interface explicitly — "
         "operational telemetry is nobody else's business)",
     )
+    p.add_argument(
+        "--leakmon",
+        action="store_true",
+        help="continuously audit the ORAM transcript for obliviousness "
+        "leaks (obs/leakmon.py): sliding-window same-key collision / "
+        "cross-round repeat / uniformity detectors, a /leakaudit verdict "
+        "on the metrics endpoint, and the round flight recorder on "
+        "/flightrec. Device-owning roles only (mono, engine) — a "
+        "frontend never sees a transcript (OPERATIONS.md §10)",
+    )
+    p.add_argument(
+        "--leakmon-window",
+        type=int,
+        default=256,
+        help="leak monitor sliding window, in per-stream observations "
+        "(default 256; larger = more statistical power, slower to "
+        "flag AND to clear — OPERATIONS.md §10)",
+    )
+    p.add_argument(
+        "--leakmon-uniformity-z",
+        type=float,
+        default=8.0,
+        help="|z| threshold for the pooled-leaf uniformity detector "
+        "(default 8.0; honest transcripts give |z| = O(1))",
+    )
+    p.add_argument(
+        "--leakmon-collision-threshold",
+        type=float,
+        default=0.02,
+        help="windowed same-key leaf collision rate above this is "
+        "SUSPECT (default 0.02; honest rate is 1/leaves)",
+    )
+    p.add_argument(
+        "--leakmon-repeat-threshold",
+        type=float,
+        default=0.05,
+        help="windowed cross-round leaf repeat rate above this is "
+        "SUSPECT (default 0.05; honest rate is 1/leaves)",
+    )
+    p.add_argument(
+        "--leakmon-dump-path",
+        help="file the flight recorder dumps to on a PASS→SUSPECT "
+        "transition (default: no automatic dump; /flightrec always "
+        "serves the ring on demand)",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -113,18 +158,42 @@ def build_parser() -> argparse.ArgumentParser:
 #: outside its role's set is a misconfiguration, and silently dropping
 #: it would hide exactly the kind of mistake (expecting TLS or a pinned
 #: identity on the wrong listener) that must fail loudly
+#: the leak monitor audits the device transcript, so only device-owning
+#: roles take its flags — a frontend supplying --leakmon-* is exactly
+#: the "expected monitoring that isn't happening" misconfiguration this
+#: matrix exists to catch
+_LEAKMON_FLAGS = {"leakmon", "leakmon_window", "leakmon_uniformity_z",
+                  "leakmon_collision_threshold",
+                  "leakmon_repeat_threshold", "leakmon_dump_path"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
-             "metrics_port", "metrics_host"},
+             "metrics_port", "metrics_host"} | _LEAKMON_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
-               "seed", "verbose", "role", "metrics_port", "metrics_host"},
+               "seed", "verbose", "role", "metrics_port", "metrics_host"}
+              | _LEAKMON_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
 }
+
+
+def _leakmon_config(args):
+    """The LeakMonitorConfig for --leakmon, or None when off."""
+    if not args.leakmon:
+        return None
+    from ..obs.leakmon import LeakMonitorConfig
+
+    return LeakMonitorConfig(
+        window_rounds=args.leakmon_window,
+        uniformity_z_threshold=args.leakmon_uniformity_z,
+        collision_threshold=args.leakmon_collision_threshold,
+        repeat_threshold=args.leakmon_repeat_threshold,
+        dump_path=args.leakmon_dump_path,
+    )
 
 
 def _reject_misapplied_flags(parser, args, argv):
@@ -185,7 +254,8 @@ def main(argv=None) -> int:
         from .tier import EngineServer
 
         engine = EngineServer(config, seed=args.seed,
-                              max_wait_ms=args.batch_wait_ms)
+                              max_wait_ms=args.batch_wait_ms,
+                              leakmon=_leakmon_config(args))
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
@@ -214,7 +284,7 @@ def main(argv=None) -> int:
 
         server = GrapevineServer(
             config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
-            identity=identity,
+            identity=identity, leakmon=_leakmon_config(args),
         )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None

@@ -176,9 +176,13 @@ class BatchScheduler:
                         self._head_enqueue = time.monotonic()
                     if self.metrics is not None:
                         self.metrics.observe_queue_depth(len(self._queue))
-                        self.metrics.observe_phase(
-                            "assembly", time.monotonic() - t_asm0
-                        )
+                        asm_s = time.monotonic() - t_asm0
+                        self.metrics.observe_phase("assembly", asm_s)
+                        lm = getattr(self.engine, "leakmon", None)
+                        if lm is not None:
+                            # flight-recorder context: the collection
+                            # window that fed the next dispatched round
+                            lm.note_phase("assembly", asm_s)
                         if hit_cap and len(chunk) < bs:
                             # window closed by the max_wait cap, not by
                             # quiescence or a full batch: arrivals are
@@ -193,11 +197,15 @@ class BatchScheduler:
             ]
             pending, live = (None, [])
             if chunk:
+                t_v0 = time.monotonic()
                 if self.metrics is not None:
                     with self.metrics.time_phase("verify"):
                         live = self._verify_chunk(chunk)
                 else:
                     live = self._verify_chunk(chunk)
+                lm = getattr(self.engine, "leakmon", None)
+                if lm is not None:
+                    lm.note_phase("verify", time.monotonic() - t_v0)
                 if live:
                     reqs = [r for r, _ in live]
                     try:

@@ -85,6 +85,7 @@ class GrapevineServer:
         max_sessions: int = 4096,
         identity: chan.ServerIdentity | None = None,
         scheduler=None,
+        leakmon=None,
     ):
         self.config = config or GrapevineConfig()
         if scheduler is not None:
@@ -131,6 +132,20 @@ class GrapevineServer:
             "grapevine_sessions", "live authenticated sessions"
         )
         self._metrics_server = None
+        #: continuous obliviousness auditing (obs/leakmon.py): pass a
+        #: LeakMonitorConfig to watch every round's transcript. Device-
+        #: owner only — the frontend role never sees a transcript.
+        self.leakmon = None
+        if leakmon is not None:
+            if self.engine is None:
+                raise ValueError(
+                    "leak monitoring needs the device engine in-process "
+                    "(the frontend role has no transcript to audit)"
+                )
+            from ..obs.leakmon import EngineLeakMonitor
+
+            self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
+            self.engine.attach_leakmon(self.leakmon)
 
     # -- RPC handlers (raw-bytes serializers) ---------------------------
 
@@ -314,6 +329,15 @@ class GrapevineServer:
         if self.engine is not None:
             age = self.engine.metrics.last_round_age()
             detail["last_round_age_s"] = None if age is None else round(age, 3)
+        if self.leakmon is not None:
+            # the leak audit verdict is part of liveness: a SUSPECT
+            # transcript means the engine is *misbehaving* even though
+            # it is serving — stop routing to it (OPERATIONS.md runbook:
+            # quarantine, dump, re-baseline). Cached verdict: /healthz
+            # must not pay detector math on the probe path.
+            v = self.leakmon.last_verdict()
+            detail["leakaudit"] = v["verdict"]
+            healthy = healthy and v["verdict"] == "PASS"
         return healthy, detail
 
     def start_metrics(self, port: int, host: str = "127.0.0.1",
@@ -323,6 +347,7 @@ class GrapevineServer:
         wires ``--metrics-port`` here."""
         from ..obs import MetricsServer
 
+        lm = self.leakmon
         self._metrics_server = MetricsServer(
             self.metrics_registry,
             health=lambda: self.healthz(stall_threshold),
@@ -330,6 +355,8 @@ class GrapevineServer:
                      else None),
             host=host,
             port=port,
+            leakaudit=lm.verdict if lm is not None else None,
+            flightrec=lm.recorder.dump if lm is not None else None,
         )
         return self._metrics_server.start()
 
@@ -345,6 +372,8 @@ class GrapevineServer:
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
         self.scheduler.close()
+        if self.leakmon is not None:
+            self.leakmon.close()
 
     def wait(self):
         if self._grpc_server is not None:

@@ -63,7 +63,7 @@ class EngineServer:
     """
 
     def __init__(self, config: GrapevineConfig | None = None, seed: int = 0,
-                 max_wait_ms: float | None = None, clock=None):
+                 max_wait_ms: float | None = None, clock=None, leakmon=None):
         from ..engine.batcher import GrapevineEngine
         from ..session import get_signature_scheme
         from .scheduler import BatchScheduler
@@ -72,6 +72,14 @@ class EngineServer:
 
         self.config = config or GrapevineConfig()
         self.engine = GrapevineEngine(self.config, seed=seed)
+        #: continuous obliviousness auditing (obs/leakmon.py) — the
+        #: engine tier owns the device, so it owns the transcript audit
+        self.leakmon = None
+        if leakmon is not None:
+            from ..obs.leakmon import EngineLeakMonitor
+
+            self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
+            self.engine.attach_leakmon(self.leakmon)
         kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
         self.scheduler = BatchScheduler(
             self.engine,
@@ -155,11 +163,20 @@ class EngineServer:
         alive = self.scheduler.worker_alive()
         stall = self.scheduler.stall_age()
         age = self.engine.metrics.last_round_age()
-        return alive and stall < stall_threshold, {
+        healthy = alive and stall < stall_threshold
+        detail = {
             "worker_alive": alive,
             "stall_age_s": round(stall, 3),
             "last_round_age_s": None if age is None else round(age, 3),
         }
+        if self.leakmon is not None:
+            # same folding as the monolithic server: a SUSPECT transcript
+            # is a serving fault — 503 stops routing (cached verdict; the
+            # probe path never pays detector math)
+            v = self.leakmon.last_verdict()
+            detail["leakaudit"] = v["verdict"]
+            healthy = healthy and v["verdict"] == "PASS"
+        return healthy, detail
 
     def start_metrics(self, port: int, host: str = "127.0.0.1",
                       stall_threshold: float = 30.0) -> int:
@@ -169,12 +186,15 @@ class EngineServer:
         session-layer registry."""
         from ..obs import MetricsServer
 
+        lm = self.leakmon
         self._metrics_server = MetricsServer(
             self.engine.metrics.registry,
             health=lambda: self.healthz(stall_threshold),
             refresh=self.engine.sample_stash,
             host=host,
             port=port,
+            leakaudit=lm.verdict if lm is not None else None,
+            flightrec=lm.recorder.dump if lm is not None else None,
         )
         return self._metrics_server.start()
 
@@ -186,6 +206,8 @@ class EngineServer:
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
         self.scheduler.close()
+        if self.leakmon is not None:
+            self.leakmon.close()
 
 
 class _EngineStub:

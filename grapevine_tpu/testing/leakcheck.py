@@ -45,6 +45,40 @@ def samekey_leaf_collisions(keys: np.ndarray, leaves: np.ndarray) -> int:
     return int(np.sum(same_key & same_leaf & upper))
 
 
+def samekey_collision_counts(
+    keys: np.ndarray, leaves: np.ndarray
+) -> tuple[int, int]:
+    """(collisions, same-key pairs) for one round — the streaming form.
+
+    Same statistic as :func:`samekey_leaf_collisions` plus the pair
+    denominator, but grouped (O(B log B)) instead of all-pairs (O(B²))
+    so the continuous monitor (obs/leakmon.py) can afford it every
+    round at production batch sizes. Entries with ``keys < 0`` are
+    excluded (the caller's "no key" sentinel for padding dummies and
+    host-unresolvable ops); the quadratic detector instead counts
+    whatever key values it is given, so callers there mask dummies
+    themselves. tests/test_leakmon.py asserts both forms agree.
+    """
+    keys = np.asarray(keys).ravel()
+    leaves = np.asarray(leaves).ravel()
+    real = keys >= 0
+    k, lf = keys[real], leaves[real]
+    if k.size < 2:
+        return 0, 0
+
+    def _pairs(counts: np.ndarray) -> int:
+        counts = counts.astype(np.int64)
+        return int(np.sum(counts * (counts - 1) // 2))
+
+    _, key_counts = np.unique(k, return_counts=True)
+    _, pair_counts = np.unique(
+        np.stack([k.astype(np.int64), np.asarray(lf, np.int64)], axis=1),
+        axis=0,
+        return_counts=True,
+    )
+    return _pairs(pair_counts), _pairs(key_counts)
+
+
 def cross_round_repeat_rate(leaf_seq: np.ndarray) -> float:
     """Fraction of consecutive accesses to ONE key with equal leaves.
 
@@ -135,8 +169,20 @@ def uniformity_z(leaves: np.ndarray, n_leaves: int, bins: int = 16) -> float:
     instead of an exact p-value to avoid a scipy dependency; the canary
     asserts orders-of-magnitude separation, not a 5% cut.)
     """
-    counts = _leaf_hist(leaves, n_leaves, bins)
-    n = int(counts.sum())
+    return uniformity_z_from_counts(_leaf_hist(leaves, n_leaves, bins))
+
+
+def uniformity_z_from_counts(counts: np.ndarray) -> float:
+    """The chi-square z of :func:`uniformity_z` from a pre-binned
+    histogram. Split out so the streaming monitor (obs/leakmon.py) can
+    keep per-round bin counts in its sliding window — summing fixed-size
+    histograms instead of pooling raw leaf arrays — and still compute
+    the identical statistic."""
+    counts = np.asarray(counts, dtype=float)
+    bins = counts.size
+    n = float(counts.sum())
+    if n == 0 or bins < 2:
+        return 0.0
     expected = n / bins
     chi2 = float(np.sum((counts - expected) ** 2) / expected)
     dof = bins - 1

@@ -1,0 +1,250 @@
+"""/leakaudit + /flightrec over a live engine tier (ISSUE 2 tentpole).
+
+Mirrors tests/test_obs_endpoint.py's approach: the engine tier imports
+without the session layer's `cryptography` dependency, and its metrics
+endpoint machinery is byte-identical to the monolithic server's. Covers
+the serving surface of the continuous obliviousness audit:
+
+- /leakaudit serves the machine-readable verdict (per-detector
+  statistic, threshold, window, sample counts) with HTTP 200 on PASS;
+- honest traffic through the real scheduler + engine stays PASS and
+  /healthz carries the folded verdict;
+- a SUSPECT verdict flips /leakaudit AND /healthz to 503, and the
+  flight recorder auto-dumps to the configured path;
+- /flightrec serves the ring dump; both endpoints 404 when the monitor
+  is off;
+- the --leakmon-* CLI flags build the right config and obey the role
+  matrix (device-owning roles only).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.obs.leakmon import LeakMonitorConfig
+from grapevine_tpu.server import cli
+from grapevine_tpu.server.tier import EngineServer
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+
+def _req(rt, auth, recipient=C.ZERO_PUBKEY, msg_id=C.ZERO_MSG_ID):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id,
+            recipient=recipient,
+            payload=b"\x07" * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:  # 503 still carries a body
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def tier(tmp_path_factory):
+    dump_path = str(tmp_path_factory.mktemp("leakmon") / "flight.json")
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0,
+        max_messages=64,
+        max_recipients=16,
+        mailbox_cap=4,
+        batch_size=4,
+        stash_size=96,
+    )
+    srv = EngineServer(
+        cfg, seed=7, max_wait_ms=5.0, clock=lambda: NOW,
+        leakmon=LeakMonitorConfig(
+            window_rounds=64,
+            min_pairs=4, min_opportunities=4, min_pooled_leaves=32,
+            dump_path=dump_path,
+        ),
+    )
+    port = srv.start_metrics(0, host="127.0.0.1")
+    yield srv, port, dump_path
+    srv.stop()
+
+
+def test_leakaudit_serves_verdict_and_healthz_folds_it(tier):
+    srv, port, _ = tier
+    # honest traffic through the real scheduler + engine + monitor
+    a, b = bytes([1]) * 32, bytes([2]) * 32
+    for i in range(12):
+        resp = srv.scheduler.submit(_req(C.REQUEST_TYPE_CREATE, a, recipient=b))
+        assert resp.status_code in (
+            C.STATUS_CODE_SUCCESS,
+            C.STATUS_CODE_TOO_MANY_MESSAGES_FOR_RECIPIENT,
+        )
+        srv.scheduler.submit(_req(C.REQUEST_TYPE_READ, b))
+    assert srv.leakmon.flush(30), "monitor queue did not drain"
+
+    status, body = _get(f"http://127.0.0.1:{port}/leakaudit")
+    assert status == 200, body
+    v = json.loads(body)
+    assert v["verdict"] == "PASS"
+    assert v["rounds_observed"] >= 12
+    assert v["window_rounds"] == 64
+    names = {(d["name"], d["tree"]) for d in v["detectors"]}
+    assert names == {
+        (n, t)
+        for n in ("samekey_collision", "cross_round_repeat", "uniformity")
+        for t in ("rec", "mb")
+    }
+    for d in v["detectors"]:  # machine-readable: every field present
+        for field in ("statistic", "threshold", "samples", "min_samples",
+                      "verdict"):
+            assert field in d
+
+    status, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 200
+    assert json.loads(body)["leakaudit"] == "PASS"
+
+    # leakmon aggregates ride the merged /metrics view
+    status, text = _get(f"http://127.0.0.1:{port}/metrics")
+    assert status == 200
+    assert 'grapevine_leakmon_rounds_total' in text
+    assert 'grapevine_leakmon_uniformity_z{tree="rec"}' in text
+    assert 'grapevine_leakmon_suspect 0' in text
+
+
+def test_flightrec_serves_ring_dump(tier):
+    srv, port, _ = tier
+    status, body = _get(f"http://127.0.0.1:{port}/flightrec")
+    assert status == 200
+    dump = json.loads(body)
+    assert dump["retained"] >= 1
+    last = dump["rounds"][-1]
+    assert {"seq", "fill", "phase_s", "stats", "verdict"} <= set(last)
+    # the scheduler's hand-off threaded assembly timing into the summary
+    assert "assembly" in last["phase_s"]
+    assert "dispatch" in last["phase_s"]
+
+
+def test_suspect_flips_endpoints_and_dumps_flight_recorder(tier):
+    """Feed the monitor a no-remap-shaped synthetic stream (same key,
+    same leaf, round after round) and watch the whole serving surface
+    flip: /leakaudit 503, /healthz 503 with the folded verdict, the
+    flight recorder dumped to the configured path. Then confirm the
+    window drains back to PASS — the runbook's re-baseline."""
+    srv, port, dump_path = tier
+    mon = srv.leakmon.monitor
+    for _ in range(16):
+        mon.observe("rec", np.zeros(4, np.int64), np.full(4, 3))
+    # the worker caches verdicts per engine round; the synthetic feed
+    # bypasses it, so push one real round through to refresh the cache
+    srv.scheduler.submit(_req(C.REQUEST_TYPE_READ, bytes([2]) * 32))
+    assert srv.leakmon.flush(30)
+
+    status, body = _get(f"http://127.0.0.1:{port}/leakaudit")
+    assert status == 503
+    v = json.loads(body)
+    assert v["verdict"] == "SUSPECT"
+    tripped = [d for d in v["detectors"] if d["verdict"] == "SUSPECT"]
+    assert any(d["name"] == "cross_round_repeat" for d in tripped)
+
+    status, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 503
+    assert json.loads(body)["leakaudit"] == "SUSPECT"
+
+    with open(dump_path, encoding="utf-8") as fh:
+        dumped = json.load(fh)
+    assert dumped["retained"] >= 1  # the PASS→SUSPECT transition dumped
+
+    # drain: honest synthetic rounds age the leak out of the window
+    rng = np.random.default_rng(3)
+    for _ in range(80):
+        mon.observe(
+            "rec", np.arange(4, dtype=np.int64),
+            rng.integers(0, srv.engine.ecfg.rec.leaves, size=4),
+        )
+    srv.scheduler.submit(_req(C.REQUEST_TYPE_READ, bytes([2]) * 32))
+    assert srv.leakmon.flush(30)
+    status, _ = _get(f"http://127.0.0.1:{port}/leakaudit")
+    assert status == 200
+    status, _ = _get(f"http://127.0.0.1:{port}/healthz")
+    assert status == 200
+
+
+def test_endpoints_404_without_monitor():
+    cfg = GrapevineConfig(
+        bucket_cipher_rounds=0, max_messages=64, max_recipients=16,
+        mailbox_cap=4, batch_size=4, stash_size=96,
+    )
+    srv = EngineServer(cfg, seed=9, max_wait_ms=5.0, clock=lambda: NOW)
+    port = srv.start_metrics(0, host="127.0.0.1")
+    try:
+        assert _get(f"http://127.0.0.1:{port}/leakaudit")[0] == 404
+        assert _get(f"http://127.0.0.1:{port}/flightrec")[0] == 404
+        assert _get(f"http://127.0.0.1:{port}/healthz")[0] == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# CLI flag plumbing
+# ---------------------------------------------------------------------
+
+
+def _parse(argv):
+    parser = cli.build_parser()
+    args = parser.parse_args(argv)
+    cli._reject_misapplied_flags(parser, args, argv)
+    return args
+
+
+def test_cli_leakmon_config_built_from_flags():
+    args = _parse([
+        "--role", "engine", "--engine-listen", "127.0.0.1:0",
+        "--leakmon", "--leakmon-window", "128",
+        "--leakmon-uniformity-z", "6.5",
+        "--leakmon-collision-threshold", "0.01",
+        "--leakmon-repeat-threshold", "0.03",
+        "--leakmon-dump-path", "/tmp/fr.json",
+    ])
+    lcfg = cli._leakmon_config(args)
+    assert lcfg is not None
+    assert lcfg.window_rounds == 128
+    assert lcfg.uniformity_z_threshold == 6.5
+    assert lcfg.collision_threshold == 0.01
+    assert lcfg.repeat_threshold == 0.03
+    assert lcfg.dump_path == "/tmp/fr.json"
+
+
+def test_cli_leakmon_off_by_default():
+    args = _parse(["--role", "engine", "--engine-listen", "127.0.0.1:0"])
+    assert cli._leakmon_config(args) is None
+
+
+@pytest.mark.parametrize("argv", [
+    ["--role", "mono", "--leakmon"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0", "--leakmon",
+     "--leakmon-window", "512"],
+])
+def test_cli_leakmon_allowed_on_device_roles(argv):
+    _parse(argv)  # must not raise
+
+
+@pytest.mark.parametrize("argv", [
+    ["--role", "frontend", "--engine", "127.0.0.1:4000", "--leakmon"],
+    ["--role", "frontend", "--engine", "127.0.0.1:4000",
+     "--leakmon-window", "64"],
+])
+def test_cli_leakmon_rejected_on_frontend(argv):
+    """A frontend has no transcript; expecting monitoring there is the
+    misconfiguration the role matrix exists to catch."""
+    with pytest.raises(SystemExit, match="does not take"):
+        _parse(argv)

@@ -135,3 +135,53 @@ def test_prometheus_render_format():
     assert 'grapevine_t_seconds_count{phase="verify"} 3' in text
     # the undriven series exists with zero samples (stable scrape schema)
     assert 'grapevine_t_seconds_count{phase="dispatch"} 0' in text
+
+
+def test_prometheus_escaping_per_0_0_4():
+    """ISSUE 2 satellite: HELP text escapes ``\\`` and newlines; label
+    values escape ``\\``, ``"``, and newlines — a declared value with a
+    quote must not corrupt the series name for everything after it."""
+    reg = TelemetryRegistry()
+    g = reg.gauge(
+        "grapevine_esc_test",
+        'help with \\ backslash\nand "newline" line',
+        labels={"phase": ('va"l\\ue\nx', "plain")},
+    )
+    g.set(1.0, phase='va"l\\ue\nx')
+    text = render_prometheus(reg)
+    assert (
+        "# HELP grapevine_esc_test "
+        'help with \\\\ backslash\\nand "newline" line'
+    ) in text
+    assert 'grapevine_esc_test{phase="va\\"l\\\\ue\\nx"} 1' in text
+    # every line still parses as comment-or-sample (no raw newlines
+    # smuggled mid-line)
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
+
+
+def test_leakmon_gauges_under_registry_policy():
+    """The leakmon namespace registers through the same audited
+    registry: tree-labeled aggregates only, audit() clean."""
+    from grapevine_tpu.obs.flightrec import FlightRecorder
+    from grapevine_tpu.obs.leakmon import EngineLeakMonitor
+
+    em = EngineMetrics()
+    mon = EngineLeakMonitor(
+        mb_leaves=16, rec_leaves=128, mb_choices=2,
+        registry=em.registry, recorder=FlightRecorder(capacity=8),
+    )
+    try:
+        report = em.registry.audit()
+        assert report["ok"]
+        fams = [m.name for m in em.registry.collect()
+                if m.name.startswith("grapevine_leakmon_")]
+        assert "grapevine_leakmon_samekey_collision_rate" in fams
+        assert "grapevine_leakmon_cross_round_repeat_rate" in fams
+        assert "grapevine_leakmon_uniformity_z" in fams
+        assert "grapevine_leakmon_suspect" in fams
+        for m in em.registry.collect():
+            if m.name.startswith("grapevine_leakmon_"):
+                assert set(m.label_keys) <= {"tree"}
+    finally:
+        mon.close()

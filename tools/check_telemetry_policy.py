@@ -116,15 +116,67 @@ def audit_shipped_registry() -> dict:
     return EngineMetrics().registry.audit()
 
 
+def audit_leakmon_registry() -> dict:
+    """Runtime pass over the leak monitor's metric namespace.
+
+    Builds the registry exactly as a --leakmon engine does (EngineMetrics
+    + EngineLeakMonitor on the same registry) and asserts, beyond the
+    generic ``audit()``:
+
+    - the ``grapevine_leakmon_*`` families exist (the continuous audit
+      is actually exporting, not silently unregistered);
+    - their only label key is ``tree`` with the declared tree names —
+      aggregate-only by construction, never per-client/per-op;
+    - any histogram in the namespace has registration-fixed buckets
+      (audit() re-checks the boundaries object-level).
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.engine.metrics import EngineMetrics
+    from grapevine_tpu.obs.flightrec import FlightRecorder
+    from grapevine_tpu.obs.leakmon import EngineLeakMonitor
+
+    em = EngineMetrics()
+    mon = EngineLeakMonitor(
+        mb_leaves=1 << 4, rec_leaves=1 << 7, mb_choices=2,
+        registry=em.registry, recorder=FlightRecorder(capacity=8),
+    )
+    try:
+        report = em.registry.audit()  # raises on any violation
+        families = [
+            m for m in em.registry.collect()
+            if m.name.startswith("grapevine_leakmon_")
+        ]
+        if not families:
+            raise SystemExit(
+                "leakmon namespace missing: EngineLeakMonitor registered "
+                "no grapevine_leakmon_* metrics"
+            )
+        for m in families:
+            bad = set(m.label_keys) - {"tree"}
+            if bad:
+                raise SystemExit(
+                    f"leakmon metric {m.name!r} carries label keys "
+                    f"{sorted(bad)} — the continuous audit may only "
+                    "aggregate by tree"
+                )
+        report["leakmon_families"] = len(families)
+        return report
+    finally:
+        mon.close()
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
         print(f"TELEMETRY POLICY VIOLATION: {v}", file=sys.stderr)
     report = audit_shipped_registry()
+    lm_report = audit_leakmon_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
-        f"({report['metrics']} metrics, {report['series']} series)"
+        f"({report['metrics']} metrics, {report['series']} series); "
+        f"leakmon audit ok ({lm_report['leakmon_families']} families, "
+        f"{lm_report['series']} series incl. engine)"
     )
     return 1 if violations else 0
 
