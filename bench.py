@@ -10,10 +10,14 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
   3c. zipf_pallas_fused  …plus the path fetch and write-back fused
                          into the cipher passes (Mosaic backends only)
   4. expiry_sweep        timestamped eviction scan, 2^22 at density 4
+  4b. vphases_ab         dense vs scan slot-order machinery A/B —
+                         B-sweep (64/256/1024) of per-op round cost,
+                         interleaved (PR3; PERF.md Round 6)
   5. sharded             bucket-tree sharded over a device mesh (CPU
                          mesh subprocess when one chip is visible)
   6. server_loopback     full-stack gRPC: session crypto + batched
                          verification + pipelined scheduler + engine
+                         (skipped, not errored, without `cryptography`)
 
 stdout is ONE JSON line: the headline mixed-CRUD throughput at the
 largest batched config, with every config's (ops/s, p99 round ms)
@@ -38,13 +42,15 @@ def _p99(times_s: list[float]) -> float:
     return float(np.percentile(np.asarray(times_s) * 1e3, 99))
 
 
-def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp"):
+def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp",
+               vphases_impl=None, cipher_rounds=8, mailbox_cap=None):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
     from grapevine_tpu.engine.round_step import engine_round_step
     from grapevine_tpu.engine.state import EngineConfig, init_engine
 
+    extra = {} if mailbox_cap is None else {"mailbox_cap": mailbox_cap}
     cfg = GrapevineConfig(
         max_messages=cap,
         max_recipients=recips,
@@ -52,6 +58,9 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="j
         stash_size=stash or max(128, batch // 2 + 96),
         tree_density=density,
         bucket_cipher_impl=cipher_impl,
+        bucket_cipher_rounds=cipher_rounds,
+        vphases_impl=vphases_impl,
+        **extra,
     )
     ecfg = EngineConfig.from_config(cfg)
     state = init_engine(ecfg, seed=seed)
@@ -333,6 +342,151 @@ def _fused_plumbing_proof(impl="pallas_fused"):
     }
 
 
+def bench_vphases_ab(smoke):
+    """Config 7: dense vs scan slot-order machinery A/B (PR3 tentpole).
+
+    B-sweep of whole-round per-op cost with ``vphases_impl`` as the only
+    difference (bit-identical semantics, tests/test_vphases_scan.py).
+    Geometry choices, deliberately:
+
+    - cipher rounds 0: ChaCha8 on a scalar backend is ~90% of round
+      time and identical under both impls — it would bury the A/B;
+    - small trees (2^12) + mailbox_cap 8: bounds the gather/scatter
+      share and compile time so three B points fit the per-config cap;
+    - rounds interleaved dense/scan, compared by MINIMUM round time:
+      the round is oblivious (shape-static, data-independent), so its
+      true cost is a constant and the min is the unbiased estimator
+      under this sandbox's 2-vCPU scheduler noise (back-to-back
+      identical runs were measured 2× apart on wall-clock medians).
+
+    Override the sweep with GRAPEVINE_VPHASES_AB_BS="64,256,..." — the
+    dense quadratic term grows as B² against the round's ~linear rest,
+    so the ratio rises with B (PERF.md Round 6 has the measured curve
+    and the B=4096 memory math)."""
+    import os
+    import time as _time
+
+    import jax
+
+    sweep = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_VPHASES_AB_BS", "64,256,1024"
+        ).split(",")
+    ]
+    n_timed = 5 if smoke else 9
+    out = {"sweep": {}}
+    for B in sweep:
+        ctxs = {}
+        for impl in ("dense", "scan"):
+            cfg, ecfg, state, step = _mk_engine(
+                1 << 12, 1 << 9, B, vphases_impl=impl, cipher_rounds=0,
+                mailbox_cap=8,
+            )
+            batches = make_batches(3, B, seed=13)
+            state, resp, _ = step(ecfg, state, batches[0])
+            jax.block_until_ready(resp)  # compile + warm
+            ctxs[impl] = [ecfg, state, step, batches]
+
+        def one_round(ctx, i):
+            ecfg, state, step, batches = ctx
+            t0 = _time.perf_counter()
+            state, resp, _ = step(ecfg, state, batches[i % 3])
+            jax.block_until_ready(resp)
+            ctx[1] = state
+            return _time.perf_counter() - t0
+
+        times = {"dense": [], "scan": []}
+        for i in range(n_timed):  # interleaved A/B
+            times["dense"].append(one_round(ctxs["dense"], i))
+            times["scan"].append(one_round(ctxs["scan"], i))
+        md = float(np.min(times["dense"]))
+        ms = float(np.min(times["scan"]))
+        out["sweep"][str(B)] = {
+            "dense_ms_per_op": round(md / B * 1e3, 4),
+            "scan_ms_per_op": round(ms / B * 1e3, 4),
+            "dense_round_ms": round(md * 1e3, 2),
+            "scan_round_ms": round(ms * 1e3, 2),
+            "median_dense_round_ms": round(
+                float(np.median(times["dense"])) * 1e3, 2
+            ),
+            "median_scan_round_ms": round(
+                float(np.median(times["scan"])) * 1e3, 2
+            ),
+            "speedup": round(md / ms, 3),
+        }
+        if B == 256:
+            out["b256_dense_ms_per_op"] = out["sweep"]["256"]["dense_ms_per_op"]
+            out["b256_scan_ms_per_op"] = out["sweep"]["256"]["scan_ms_per_op"]
+            out["b256_speedup"] = out["sweep"]["256"]["speedup"]
+    out["machinery"] = _vphases_machinery_sweep(smoke)
+    return out
+
+
+def _vphases_machinery_sweep(smoke):
+    """Isolated group-aggregation machinery A/B (the exact term the
+    vphases_impl knob swaps): one jit per (B, impl) exercising every
+    group method at representative shapes. Unlike the whole-round A/B
+    this is stable under the sandbox scheduler (sub-ms to ~100 ms ops,
+    min-of-9) and shows the clean O(B²) vs O(B log B) separation the
+    whole round dilutes with tree gather/scatter traffic."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from grapevine_tpu.engine import vphases as V
+
+    def one(B, impl, reps):
+        rng = np.random.default_rng(0)
+        ka = jnp.asarray(
+            rng.integers(0, max(2, B // 8), (B, 8)).astype(np.uint32)
+        )
+        is_real = jnp.asarray(rng.random(B) < 0.9)
+        flags = jnp.asarray(rng.random(B) < 0.3)
+        u = jnp.asarray(rng.random((B, 248)) < 0.1)
+        q = jnp.asarray(rng.integers(-2, 5, B).astype(np.int32))
+        vals = jnp.asarray(rng.integers(0, 1 << 30, (B, 2)).astype(np.uint32))
+
+        class E:
+            vphases_impl = impl
+
+        def work(ka, is_real, flags, u, q, vals):
+            g = V._recipient_groups(E, ka, is_real)
+            return [
+                g.counts_before(flags), g.any_before(flags),
+                g.total_sum(flags), g.total_or(flags), g.total_or_rows(u),
+                g.total_sum_rows(u), g.group_first(), g.group_last(),
+                g.first_flag_index(flags)[0],
+                g.last_flag_index_upto(flags), g.last_flag_index(flags),
+                g.select_by_rank(flags, vals, q),
+            ]
+
+        f = jax.jit(work)
+        o = f(ka, is_real, flags, u, q, vals)
+        jax.block_until_ready(o)
+        ts = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            o = f(ka, is_real, flags, u, q, vals)
+            jax.block_until_ready(o)
+            ts.append(_time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    sweep = (256, 1024) if smoke else (256, 1024, 2048, 4096)
+    reps = 5 if smoke else 9
+    res = {}
+    for B in sweep:
+        d = one(B, "dense", reps)
+        s = one(B, "scan", reps)
+        res[str(B)] = {
+            "dense_ms": round(d * 1e3, 2),
+            "scan_ms": round(s * 1e3, 2),
+            "speedup": round(d / s, 2),
+        }
+    return res
+
+
 def bench_expiry_sweep(smoke):
     """Config 4: full-bus timestamped eviction scan (reference
     README.md:86-98) at the largest capacity that fits one chip:
@@ -413,6 +567,54 @@ def bench_sharded(smoke):
             "batch": batch, "capacity_log2": cap.bit_length() - 1, "mesh": n_dev}
 
 
+def _xla_flags_supported(flags: str) -> bool:
+    """True iff this jaxlib parses ``flags`` (older ones abort on
+    unknown XLA flags). Mirrors tests/conftest.py, incl. the per-jaxlib
+    /tmp cache so the cold probe is paid once per environment."""
+    import hashlib
+    import os
+    import subprocess
+    import tempfile
+
+    try:
+        import jaxlib
+
+        version = jaxlib.__version__
+    except Exception:
+        version = "unknown"
+    tag = hashlib.sha256(f"{version}:{flags}".encode()).hexdigest()[:16]
+    cache = os.path.join(
+        tempfile.gettempdir(), f"grapevine_xla_flag_probe_{tag}"
+    )
+    try:
+        with open(cache) as fh:
+            return fh.read().strip() == "ok"
+    except OSError:
+        pass
+    probe = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'; "
+        f"os.environ['XLA_FLAGS']={flags!r}; "
+        "import jax; jax.devices()"
+    )
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False  # don't cache a flaky probe run
+    try:
+        with open(cache, "w") as fh:
+            fh.write("ok" if ok else "unsupported")
+    except OSError:
+        pass
+    return ok
+
+
 def _sharded_subprocess(smoke):
     """Run this file's sharded config on a virtual CPU mesh, isolated."""
     import json as _json
@@ -432,14 +634,20 @@ def _sharded_subprocess(smoke):
         f for f in env.get("XLA_FLAGS", "").split()
         if not any(name in f for name in _ours)
     ]
-    env["XLA_FLAGS"] = " ".join(flags + [
-        "--xla_force_host_platform_device_count=8",
-        # timesliced virtual devices rendezvous slowly on a loaded
-        # core; the default terminate timeout SIGABRTs spuriously
-        # (BIGRUN_r5.md — it is a flag, not a scale wall)
+    # timesliced virtual devices rendezvous slowly on a loaded core;
+    # the default terminate timeout SIGABRTs spuriously (BIGRUN_r5.md —
+    # it is a flag, not a scale wall). But older jaxlibs CHECK-fail-
+    # abort on *unknown* XLA flags (the PR-1 conftest lesson), so probe
+    # support in a throwaway subprocess before adding them.
+    _timeouts = [
         "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
         "--xla_cpu_collective_call_terminate_timeout_seconds=600",
-    ])
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags
+        + ["--xla_force_host_platform_device_count=8"]
+        + (_timeouts if _xla_flags_supported(" ".join(_timeouts)) else [])
+    )
     # always smoke-sized shapes: the sim measures host CPU, so big
     # shapes only burn driver wall-clock without adding information
     code = (
@@ -471,13 +679,22 @@ def bench_server_loopback(smoke):
     challenge lockstep + batched signature verification + engine),
     concurrent authenticated clients. Exposes the full-stack throughput
     the engine-only configs skip (VERDICT r2: the auth path capped the
-    server at O(100) ops/s before batch verification)."""
+    server at O(100) ops/s before batch verification).
+
+    The session layer needs the ``cryptography`` wheel; containers
+    without it (the builder sandbox) report a *skip*, not an error, so
+    smoke runs stay rc=0 — the driver's bench env has the wheel and
+    runs the config for real."""
     import threading
 
     from grapevine_tpu.config import GrapevineConfig
-    from grapevine_tpu.server.client import GrapevineClient
-    from grapevine_tpu.server.service import GrapevineServer
     from grapevine_tpu.wire import constants as C
+
+    try:
+        from grapevine_tpu.server.client import GrapevineClient
+        from grapevine_tpu.server.service import GrapevineServer
+    except ImportError as e:
+        return {"skipped": f"no cryptography wheel ({e})"}
 
     cap, n_clients, per_client = (1 << 10, 2, 4) if smoke else (1 << 16, 16, 24)
     cfg = GrapevineConfig(
@@ -571,6 +788,7 @@ CONFIGS = [
     ("zipf_pallas_tiled",
      lambda smoke: bench_zipf_pallas(smoke, "pallas_fused_tiled")),
     ("crd_loop", bench_crd_loop),
+    ("vphases_ab", bench_vphases_ab),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),

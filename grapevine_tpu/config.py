@@ -114,6 +114,11 @@ class GrapevineConfig:
                 f"signature_scheme must be 'schnorrkel' or 'rfc9496', got "
                 f"{self.signature_scheme!r}"
             )
+        if self.vphases_impl not in (None, "dense", "scan"):
+            raise ValueError(
+                f"vphases_impl must be None, 'dense' or 'scan', got "
+                f"{self.vphases_impl!r}"
+            )
         if self.max_messages < 2 or self.max_messages & (self.max_messages - 1):
             raise ValueError("max_messages must be a power of two >= 2")
         if self.tree_density not in (1, 2, 4):
@@ -130,6 +135,22 @@ class GrapevineConfig:
                 "commit='op' (the differential-oracle engine) supports "
                 "only mailbox_choices=1"
             )
+    #: slot-order semantics implementation for the phase-major engine's
+    #: vectorized phases (engine/vphases.py): "dense" = [B,B] masked
+    #: matrices + one-hot bool-matmuls (MXU-shaped; O(B²) compute and
+    #: intermediate memory), "scan" = group-sort + segmented scans
+    #: (O(B log B), no [B,B] intermediate — the form that scales past
+    #: B=2048). Bit-identical responses and final engine state
+    #: (tests/test_vphases_scan.py). None = auto by backend: "dense" on
+    #: TPU backends (the MXU eats the masks; flip after the
+    #: tools/tpu_capture.py ``vphases_perf`` A/B says otherwise),
+    #: "scan" elsewhere — on CPU the aggregation machinery itself
+    #: measures ~1.4× faster at B=256 rising to ~23× at B=4096, while
+    #: whole-round CPU gains stay small below B≈2048 (the round is
+    #: gather/scatter-bound; measured curve + the B=4096 dense memory
+    #: math: PERF.md Round 6).
+    vphases_impl: str | None = None
+
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient
     #: claims a slot in the emptier of two keyed-hash candidate buckets

@@ -75,6 +75,9 @@ class EngineConfig:
     mb_table_buckets: int
     mb_slots: int  # K mailboxes per hash bucket
     mb_choices: int = 1  # hash choices per recipient (2 = power-of-two)
+    #: slot-order machinery (engine/vphases.py): "dense" [B,B] masks or
+    #: "scan" sort + segmented scans — bit-identical semantics
+    vphases_impl: str = "dense"
 
     @property
     def id_bits(self) -> int:
@@ -86,6 +89,18 @@ class EngineConfig:
         m = cfg.mailbox_table_buckets
         k = max(1, cfg.mailbox_slots)
         mb_value_words = k * (KEY_WORDS + ENTRY_WORDS * cfg.mailbox_cap)
+        vimpl = cfg.vphases_impl
+        if vimpl is None:
+            # per-backend default: the MXU eats the [B,B] masks, scalar
+            # backends pay O(B²) directly (config.py knob docstring).
+            # Resolved here — engine construction time — because config
+            # objects must stay importable without initializing a JAX
+            # backend.
+            from ..config import TPU_BACKENDS
+
+            vimpl = (
+                "dense" if jax.default_backend() in TPU_BACKENDS else "scan"
+            )
         return cls(
             max_messages=cfg.max_messages,
             max_recipients=cfg.max_recipients,
@@ -113,6 +128,7 @@ class EngineConfig:
             mb_table_buckets=m,
             mb_slots=k,
             mb_choices=cfg.resolved_mailbox_choices,
+            vphases_impl=vimpl,
         )
 
 

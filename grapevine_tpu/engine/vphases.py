@@ -5,14 +5,34 @@ documents the semantics per phase) were originally applied op-by-op under
 ``lax.scan``. On TPU a scan body costs ~30-130µs *per iteration* (each
 tiny op in the body pays fixed sequencer overhead), which made the scans
 >99% of round latency. This module computes identical slot-order
-semantics with **no per-op loop at all**:
+semantics with **no per-op loop at all**, via one of two selectable
+implementations (``ecfg.vphases_impl``):
 
-- same-key chains (ops on one record / one mailbox in one round) become
-  [B,B] masked matrices — "did any earlier op of my group do X";
+- ``"dense"``: same-key chains (ops on one record / one mailbox in one
+  round) become [B,B] masked matrices — "did any earlier op of my group
+  do X" — with OR-aggregates as one-hot bool-matmuls on the MXU. O(B²)
+  compute and intermediate memory, but every op is a wide
+  matrix/reduction the MXU/VPU eat for free at moderate B.
+- ``"scan"``: the same aggregations in O(B log B) with **no [B,B]
+  intermediate at all** — sort ops by (group key, slot), answer
+  count/any-of-earlier-flagged and OR/sum-over-group queries as
+  segmented scans over the sorted order (oblivious/segmented.py), then
+  invert the permutation back to slot order. This is the
+  bandwidth-shaped form accelerator oblivious-map work (BOLT, Palermo —
+  PAPERS.md) gets its throughput from, and the form that scales past
+  B=2048 where the [B,B] masks start to own the round.
+
+Both implementations are bit-identical in responses AND final engine
+state (tests/test_vphases_scan.py holds them equal against each other
+and the CPU oracle); the per-backend default lives in
+``EngineConfig.from_config`` (engine/state.py).
+
+Common machinery either way:
+
 - the mailbox occupancy walk (CREATE = min(count+1, cap), zero-id DELETE
   pop = max(count-1, 0)) is a *saturating-counter* walk, computed exactly
   with a segmented associative scan in O(log B) depth
-  (oblivious/segmented.py);
+  (oblivious/segmented.py) — both impls share it;
 - entry selection ("pop the oldest") becomes a per-mailbox sort by seq +
   a rank gather;
 - final block values are rebuilt once per touched bucket with shifts and
@@ -23,11 +43,20 @@ Admission quotas (bus capacity, recipient-table capacity) couple ops
 state — admission decouples and everything above is exact. When the bus
 or recipient table is within B of saturation, a fallback ``lax.scan``
 over [B] resolves just the admission bits sequentially (tiny body —
-counters only, no values). The branch predicate reveals only "bus or
-recipient table nearly full", an aggregate the reference's own error
-responses already expose to clients (and Create is permitted to be
-distinguishable, reference grapevine.proto:120-122); per-op secrets never
-influence the branch.
+counters only, no values; identical under both impls). The branch
+predicate reveals only "bus or recipient table nearly full", an
+aggregate the reference's own error responses already expose to clients
+(and Create is permitted to be distinguishable, reference
+grapevine.proto:120-122); per-op secrets never influence the branch.
+
+Obliviousness note for the scan impl: it gathers at sort permutations
+and segment-boundary indices, which are functions of the batch's
+same-key structure — exactly the standing the existing admission walk's
+``group_sort`` already has (and the working-set row maps in
+oram/round.py): these are private-working-memory accesses, the EPC
+analog, not the HBM bucket-tree transcript obliviousness is claimed
+for. Dedup inside oram_round keeps same-key ops uncorrelated in the
+public transcript under either impl.
 
 Semantics notes vs the original chain engine (mirrored by the oracle):
 
@@ -57,8 +86,13 @@ from ..oblivious.primitives import (
 from ..oblivious.prp import prp2_encrypt
 from ..oblivious.segmented import (
     group_sort,
+    multiword_group_sort,
     sat_apply,
+    segment_bounds,
     segmented_exclusive_sat_scan,
+    segmented_scan,
+    segmented_sum_before,
+    segmented_sum_total,
 )
 from ..wire import constants as C
 from .state import (
@@ -111,6 +145,222 @@ def _bool_matmul(m: jax.Array, u: jax.Array) -> jax.Array:
     )
 
 
+# ----------------------------------------------------------------------
+# group aggregation engine: one semantics, two implementations
+# ----------------------------------------------------------------------
+#
+# Every within-round chain question the three phases ask is one of a
+# small set of group aggregations ("my group" = ops sharing a recipient
+# key / effective bucket / record block; dummies are singleton groups):
+#
+#   counts_before(f)       #flagged strictly-earlier ops of my group
+#   any_before(f)          counts_before > 0
+#   total_sum(f)/total_or  sum / OR over my whole group (self included)
+#   *_rows(u)              the same, aggregating bool[B,N] row vectors
+#   group_first/group_last smallest / largest slot index in my group
+#   first_flag_index(f)    slot of my group's first flagged op (+ found)
+#   last_flag_index[_upto] slot of my group's last flagged op
+#                          (optionally restricted to at-or-before me)
+#   select_by_rank(f,v,q)  v-row of my group's q-th flagged op (0 if none)
+#
+# _DenseGroups answers them with [B,B] masks and one-hot matmuls;
+# _SortedGroups answers them in O(B log B) with one multi-word sort and
+# segmented scans. The two are bit-identical on every method for the
+# flag patterns the phases produce (dummy ops never raise flags — all
+# flags are masked by is_real), which the A/B test suite enforces
+# end-to-end.
+
+
+class _DenseGroups:
+    """[B,B]-mask implementation (``vphases_impl="dense"``)."""
+
+    def __init__(self, same: jax.Array):
+        b = same.shape[0]
+        self.b = b
+        # real ops already include themselves in `same`; adding the
+        # diagonal only turns dummy rows into singleton groups, which
+        # matches the sorted impl and never changes a flagged result
+        # (dummies raise no flags)
+        self.m = same | jnp.eye(b, dtype=jnp.bool_)
+        self._same = same
+
+    def counts_before(self, flags):
+        return _counts_before(self._same, flags)
+
+    def any_before(self, flags):
+        return _any_before(self._same, flags)
+
+    def total_sum(self, flags):
+        return jnp.sum(self.m & flags[None, :], axis=1).astype(I32)
+
+    def total_or(self, flags):
+        return jnp.any(self.m & flags[None, :], axis=1)
+
+    def total_sum_rows(self, u):
+        return jnp.matmul(
+            self.m.astype(jnp.float32),
+            u.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(I32)
+
+    def total_or_rows(self, u):
+        return _bool_matmul(self.m, u)
+
+    def group_first(self):
+        return jnp.argmax(self.m, axis=1).astype(U32)
+
+    def group_last(self):
+        iota = jnp.arange(self.b, dtype=U32)
+        return jnp.max(jnp.where(self.m, iota[None, :], 0), axis=1)
+
+    def first_flag_index(self, flags):
+        oh = self.m & flags[None, :]
+        return jnp.argmax(oh, axis=1).astype(I32), jnp.any(oh, axis=1)
+
+    def last_flag_index_upto(self, flags):
+        iota = jnp.arange(self.b, dtype=I32)
+        wm = self.m & flags[None, :] & _tril(self.b, strict=False)
+        return jnp.max(jnp.where(wm, iota[None, :], -1), axis=1)
+
+    def last_flag_index(self, flags):
+        iota = jnp.arange(self.b, dtype=I32)
+        wm = self.m & flags[None, :]
+        return jnp.max(jnp.where(wm, iota[None, :], -1), axis=1)
+
+    def select_by_rank(self, flags, vals, q):
+        rank = self.counts_before(flags)
+        oh = self.m & flags[None, :] & (rank[None, :] == q[:, None])
+        return jnp.sum(oh[:, :, None] * vals[None, :, :], axis=1).astype(
+            vals.dtype
+        )
+
+
+class _SortedGroups:
+    """Sort + segmented-scan implementation (``vphases_impl="scan"``).
+
+    One O(B log B) variadic sort orders ops by (group key, slot); every
+    aggregation is then a cumsum / segmented scan over the sorted order
+    plus a permutation inverse — no [B,B] intermediate anywhere.
+    """
+
+    def __init__(self, cols):
+        self.perm, self.inv, self.seg = multiword_group_sort(cols)
+        b = self.perm.shape[0]
+        self.b = b
+        self.start, self.end = segment_bounds(self.seg)
+        self._pi = self.perm.astype(I32)
+
+    def _to(self, x):
+        return x[self.perm]
+
+    def _back(self, x):
+        return x[self.inv]
+
+    def _counts_before_sorted(self, f):
+        return segmented_sum_before(f, self.seg, (self.start, self.end))
+
+    def _total_sorted(self, x):
+        return segmented_sum_total(x, self.seg, (self.start, self.end))
+
+    def counts_before(self, flags):
+        return self._back(self._counts_before_sorted(self._to(flags)))
+
+    def any_before(self, flags):
+        return self.counts_before(flags) > 0
+
+    def total_sum(self, flags):
+        return self._back(self._total_sorted(self._to(flags)))
+
+    def total_or(self, flags):
+        return self.total_sum(flags) > 0
+
+    def total_sum_rows(self, u):
+        return self._back(self._total_sorted(self._to(u)))
+
+    def total_or_rows(self, u):
+        return self.total_sum_rows(u) > 0
+
+    def group_first(self):
+        return self._back(self.perm[self.start])
+
+    def group_last(self):
+        return self._back(self.perm[self.end])
+
+    def first_flag_index(self, flags):
+        v = jnp.where(self._to(flags), self._pi, I32(self.b))
+        m = segmented_scan(v, self.seg, jnp.minimum)[self.end]
+        has = m < self.b
+        return self._back(jnp.clip(m, 0, self.b - 1)), self._back(has)
+
+    def last_flag_index_upto(self, flags):
+        v = jnp.where(self._to(flags), self._pi, -1)
+        # within a segment ops sit in slot order, so position-≤-mine is
+        # exactly slot-≤-mine: the inclusive segmented max IS "last
+        # flagged at or before me"
+        return self._back(segmented_scan(v, self.seg, jnp.maximum))
+
+    def last_flag_index(self, flags):
+        v = jnp.where(self._to(flags), self._pi, -1)
+        return self._back(segmented_scan(v, self.seg, jnp.maximum)[self.end])
+
+    def select_by_rank(self, flags, vals, q):
+        f = self._to(flags)
+        rank = self._counts_before_sorted(f)
+        # each flagged op owns sorted slot (segment start + its rank):
+        # in-segment, collision-free — scatter values, gather at q
+        tgt = jnp.where(f, self.start + rank, I32(self.b))
+        table = (
+            jnp.zeros((self.b,) + vals.shape[1:], vals.dtype)
+            .at[tgt]
+            .set(self._to(vals), mode="drop", unique_indices=True)
+        )
+        q_s = self._to(q)
+        nfl = self._total_sorted(f)
+        pos = jnp.clip(self.start + q_s, 0, self.b - 1)
+        ok = (q_s >= 0) & (q_s < nfl)
+        return self._back(jnp.where(ok[:, None], table[pos], 0))
+
+
+def _recipient_groups(ecfg: EngineConfig, ka: jax.Array, is_real: jax.Array):
+    """Groups over the recipient key ka (dummies singleton)."""
+    b = ka.shape[0]
+    if ecfg.vphases_impl == "dense":
+        requal = (
+            words_equal(ka[:, None, :], ka[None, :, :])
+            & is_real[:, None]
+            & is_real[None, :]
+        )
+        return _DenseGroups(requal)
+    iota = jnp.arange(b, dtype=U32)
+    # key = (real?, ka words, dummy-uniquifier): real ops group by ka,
+    # each dummy is its own group
+    cols = (
+        [(~is_real).astype(U32)]
+        + [ka[:, w] for w in range(KEY_WORDS)]
+        + [jnp.where(is_real, U32(0), iota)]
+    )
+    return _SortedGroups(cols)
+
+
+def _index_groups(ecfg: EngineConfig, idx: jax.Array, is_real: jax.Array,
+                  dummy_base: int):
+    """Groups over a single u32 index column (bucket / record block).
+
+    ``dummy_base``: sorted-impl uniquifier base for dummy ops — any
+    value with ``dummy_base + iota`` disjoint from real index values.
+    """
+    b = idx.shape[0]
+    if ecfg.vphases_impl == "dense":
+        eq = (
+            (idx[:, None] == idx[None, :])
+            & is_real[:, None]
+            & is_real[None, :]
+        )
+        return _DenseGroups(eq)
+    iota = jnp.arange(b, dtype=U32)
+    return _SortedGroups([jnp.where(is_real, idx, U32(dummy_base) + iota)])
+
+
 def _mb_parse_batch(ecfg: EngineConfig, vals: jax.Array):
     """[B, Vmb] → keys [B,K,8], entries [B,K,cap,ENTRY_WORDS]."""
     b = vals.shape[0]
@@ -141,8 +391,8 @@ def _admission_fast(
     first_create,
     free_slots0,
     init_count,
-    requal,
-    gequal,
+    groups_r,
+    groups_g,
     rslot,
 ):
     """Quota-decoupled admission (bus + recipient headroom ≥ B)."""
@@ -150,10 +400,10 @@ def _admission_fast(
     cap = ecfg.mailbox_cap
 
     claim_cand = first_create & ~found0
-    claim_rank = _counts_before(gequal, claim_cand)
+    claim_rank = groups_g.counts_before(claim_cand)
     claim_ok = claim_cand & (claim_rank < free_slots0)
     # my recipient's claim, if any (claims live at the first-create op)
-    claimed_r = jnp.any(requal & (claim_ok)[None, :], axis=1)
+    claimed_r = groups_r.total_or(claim_ok)
     active = found0 | claimed_r
 
     # saturating occupancy walk per recipient, segmented by first-occ slot
@@ -188,8 +438,6 @@ def _admission_slow(
     first_create,
     free_slots0,
     init_count,
-    requal,
-    gequal,
     rslot,
     gslot,
     free_top0,
@@ -200,7 +448,8 @@ def _admission_slow(
     A tiny scan over counters only — no block values — so its per-op cost
     is bounded by a dozen scalar/[B]-element ops. Runs only when the bus
     or recipient table is within B of full (see module docstring for the
-    leak analysis of the branch)."""
+    leak analysis of the branch). Shared verbatim by both vphases
+    implementations."""
     b = rslot.shape[0]
     cap = ecfg.mailbox_cap
     iota = jnp.arange(b, dtype=U32)
@@ -293,12 +542,8 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
 
     # recipient groups (ka equality); bucket groups move inside the
     # callback — the effective bucket depends on fetched occupancy
-    requal = (
-        words_equal(ka[:, None, :], ka[None, :, :])
-        & is_real[:, None]
-        & is_real[None, :]
-    )
-    rslot = jnp.where(is_real, jnp.argmax(requal, axis=1).astype(U32), iota)
+    groups_r = _recipient_groups(ecfg, ka, is_real)
+    rslot = groups_r.group_first()
 
     def apply_batch(vals0, present0):
         # --- candidate choice: [B*D] rows → per-op chosen views -------
@@ -326,15 +571,12 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         eff_idx = jnp.take_along_axis(idxs_mb2, chosen[:, None], axis=1)[:, 0]
         eff_idx = jnp.where(is_real, eff_idx, m_sentinel + U32(1) + iota)
 
-        # bucket groups over the effective bucket
-        gequal = (
-            (eff_idx[:, None] == eff_idx[None, :])
-            & is_real[:, None]
-            & is_real[None, :]
+        # bucket groups over the effective bucket (dummies unique)
+        groups_g = _index_groups(
+            ecfg, eff_idx, is_real, ecfg.mb_table_buckets + 1
         )
-        gslot = jnp.where(is_real, jnp.argmax(gequal, axis=1).astype(U32), iota)
-        glast = jnp.max(jnp.where(gequal, iota[None, :], 0), axis=1)
-        glast = jnp.where(is_real, glast, iota)
+        gslot = groups_g.group_first()
+        glast = groups_g.group_last()
 
         key_valid0 = ~is_zero_words(keys0)  # [B,K]
         slot_match0 = key_valid0 & words_equal(keys0, ka[:, None, :])  # [B,K]
@@ -347,7 +589,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         ent_valid = (ent_r[:, :, ENT_SEQ] | ent_r[:, :, ENT_SEQH]) != 0
         init_count = jnp.sum(ent_valid, axis=1).astype(I32)
 
-        first_create = is_create_cand & ~_any_before(requal, is_create_cand)
+        first_create = is_create_cand & ~groups_r.any_before(is_create_cand)
 
         common = dict(
             is_create_cand=is_create_cand,
@@ -356,8 +598,6 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             first_create=first_create,
             free_slots0=free_slots0,
             init_count=init_count,
-            requal=requal,
-            gequal=gequal,
             rslot=rslot,
         )
         fast_ok = (ctx["free_top0"] >= U32(b)) & (
@@ -365,7 +605,9 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         )
         adm = jax.lax.cond(
             fast_ok,
-            lambda: _admission_fast(ecfg, **common),
+            lambda: _admission_fast(
+                ecfg, **common, groups_r=groups_r, groups_g=groups_g
+            ),
             lambda: _admission_slow(
                 ecfg,
                 **common,
@@ -396,8 +638,8 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         new_id = jnp.stack([w0, w1, idr[:, 1], idr[:, 2] | U32(1)], axis=1)
 
         # --- zero-id selection: p-th oldest of [initial sorted ++ creates]
-        pops_before = _counts_before(requal, pop_ok)
-        crank = _counts_before(requal, create_ok)
+        pops_before = groups_r.counts_before(pop_ok)
+        crank = groups_r.counts_before(create_ok)
         inf = U32(0xFFFFFFFF)
         sk_lo = jnp.where(ent_valid, ent_r[:, :, ENT_SEQ], inf)
         sk_hi = jnp.where(ent_valid, ent_r[:, :, ENT_SEQH], inf)
@@ -410,11 +652,9 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
             :, 0, :
         ]  # [B, ENTRY_WORDS]
         q = p - init_count
-        sel_created_oh = (
-            requal & create_ok[None, :] & (crank[None, :] == q[:, None])
-        )
-        created_blk = jnp.sum(sel_created_oh * new_id[None, :, 0], axis=1).astype(U32)
-        created_idw = jnp.sum(sel_created_oh * new_id[None, :, 1], axis=1).astype(U32)
+        created = groups_r.select_by_rank(create_ok, new_id[:, :2], q)
+        created_blk = created[:, 0]
+        created_idw = created[:, 1]
         sel_blk = jnp.where(sel_from_init, init_sel[:, ENT_BLK], created_blk)
         sel_idw = jnp.where(sel_from_init, init_sel[:, ENT_IDW], created_idw)
         sel_found = is_zsel & active & (count_before > 0)
@@ -442,7 +682,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # --- final block assembly (committed at each group's last op) --
         # claimed key slot per claim op: the claim_rank-th free slot
         free_rank = jnp.cumsum(~key_valid0, axis=1) - (~key_valid0)  # [B,K]
-        claim_rank = _counts_before(gequal, claim_ok)
+        claim_rank = groups_g.counts_before(claim_ok)
         claim_slot_oh = (
             (~key_valid0) & (free_rank == claim_rank[:, None]) & claim_ok[:, None]
         )  # [B,K]
@@ -451,7 +691,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # first op (a zero-id R/D by the same recipient may precede it in
         # slot order), so OR-aggregate over the whole group — at most one
         # op per group has claim_ok.
-        claim_slot_r = _bool_matmul(requal, claim_slot_oh)  # [B,K]
+        claim_slot_r = groups_r.total_or_rows(claim_slot_oh)  # [B,K]
         mslot_oh = jnp.where(found0[:, None], slot_match0, claim_slot_r)
         mslot_idx = jnp.argmax(mslot_oh, axis=1).astype(U32)
         has_mslot = jnp.any(mslot_oh, axis=1)
@@ -468,11 +708,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         # initial entries: survivors shift down by popped_init per slot
         # T[r,s]: total pops in r's group landing on slot s
         pop_sl = mslot_oh & pop_ok[:, None]  # [B,K]
-        T = jnp.matmul(
-            gequal.astype(jnp.float32),
-            pop_sl.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ).astype(I32)
+        T = groups_g.total_sum_rows(pop_sl)  # [B,K] i32
         valid_all = (
             entries0[:, :, :, ENT_SEQ] | entries0[:, :, :, ENT_SEQH]
         ) != 0
@@ -494,7 +730,7 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         )
 
         # created entries: survivors append after the surviving initials
-        T_r = jnp.sum(requal & pop_ok[None, :], axis=1).astype(I32)  # total pops
+        T_r = groups_r.total_sum(pop_ok)  # total pops in my group
         popped_init_r = jnp.minimum(T_r, init_count)
         popped_created_r = T_r - popped_init_r
         surv = create_ok & (crank >= popped_created_r) & has_mslot
@@ -572,14 +808,9 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
 
     b = ctx["idx_b"].shape[0]
     realb = ctx["real_b"]
-    kequal = (
-        (ctx["idx_b"][:, None] == ctx["idx_b"][None, :])
-        & realb[:, None]
-        & realb[None, :]
-    )
-    tril_s = _tril(b)
-    tril_i = _tril(b, strict=False)
-    iota = jnp.arange(b, dtype=I32)
+    # record-block groups; dummies (idx_b = rec.dummy_index, shared)
+    # must stay singletons, exactly as the realb-masked dense equality
+    groups_k = _index_groups(ecfg, ctx["idx_b"], realb, ecfg.rec.blocks + 1)
     now = ctx["now"]
     create_ev = ctx["is_create"] & ctx["create_ok"] & realb
 
@@ -591,9 +822,7 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         init_payload = vals0[:, REC_PAYLOAD]
 
         # identity fields are fixed per key: creation (in-round) or initial
-        c_oh = kequal & create_ev[None, :]
-        has_c = jnp.any(c_oh, axis=1)
-        c_idx = jnp.argmax(c_oh, axis=1)
+        c_idx, has_c = groups_k.first_flag_index(create_ev)
         sid = jnp.where(has_c[:, None], ctx["new_id"][c_idx], init_id)
         ssender = jnp.where(has_c[:, None], ctx["auth"][c_idx], init_sender)
         srecip = jnp.where(has_c[:, None], ctx["recipient"][c_idx], init_recip)
@@ -609,11 +838,9 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         del_pred = (
             ctx["is_delete"] & mtc & auth_ok & (ctx["id_zero"] | recip_match)
         )
-        created_before = _any_before(kequal, create_ev)
+        created_before = groups_k.any_before(create_ev)
         base_alive = (present0 & realb) | created_before
-        killed_before = jnp.any(
-            kequal & tril_s & (del_pred & base_alive)[None, :], axis=1
-        )
+        killed_before = groups_k.any_before(del_pred & base_alive)
         alive = base_alive & ~killed_before
 
         match_ok = alive & mtc
@@ -624,8 +851,7 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         # last payload/ts writer at-or-before me (me included for my own
         # update/create); reads see the state before themselves
         W = create_ev | upd_ok
-        wm = kequal & W[None, :] & tril_i
-        lw = jnp.max(jnp.where(wm, iota[None, :], -1), axis=1)
+        lw = groups_k.last_flag_index_upto(W)
         has_w = lw >= 0
         lwc = jnp.clip(lw, 0, b - 1)
         resp_payload = jnp.where(
@@ -649,11 +875,10 @@ def phase_b_batch(ecfg: EngineConfig, ctx: dict):
         }
 
         # final per-key state
-        any_create = jnp.any(kequal & create_ev[None, :], axis=1) | create_ev
-        any_del = jnp.any(kequal & del_ok[None, :], axis=1) | del_ok
+        any_create = groups_k.total_or(create_ev)
+        any_del = groups_k.total_or(del_ok)
         final_alive = ((present0 & realb) | any_create) & ~any_del
-        wm_all = (kequal | jnp.eye(b, dtype=jnp.bool_)) & W[None, :]
-        lwf = jnp.max(jnp.where(wm_all, iota[None, :], -1), axis=1)
+        lwf = groups_k.last_flag_index(W)
         has_wf = lwf >= 0
         lwfc = jnp.clip(lwf, 0, b - 1)
         fin_payload = jnp.where(
@@ -681,7 +906,11 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
     mutations (explicit-delete clear, update timestamp refresh) land in
     whichever candidate holds its recipient key, and are aggregated onto
     EVERY fetched row of that bucket so the round's last-occurrence
-    commit (oram_round) writes them regardless of which op's row wins."""
+    commit (oram_round) writes them regardless of which op's row wins.
+    The dense impl aggregates with a [B·D,B] one-hot matmul; the scan
+    impl scatter-adds per-bucket mutation vectors into a
+    [table_buckets, K·cap] table and gathers per row — the same dense
+    bucket-table idiom phase A's op_map already uses."""
 
     b = ctx["ka"].shape[0]
     d = ecfg.mb_choices
@@ -731,9 +960,23 @@ def phase_c_batch(ecfg: EngineConfig, ctx: dict):
 
         # aggregate op mutations onto every row of the op's bucket
         rows_idx = idxs_mb2.reshape(b * d)  # [B*D]
-        row_op = (rows_idx[:, None] == eff_idx[None, :]) & mutating[None, :]
-        clear = _bool_matmul(row_op, u_clear).reshape(b * d, k, cap)
-        refr = _bool_matmul(row_op, u_refresh).reshape(b * d, k, cap)
+        if ecfg.vphases_impl == "dense":
+            row_op = (rows_idx[:, None] == eff_idx[None, :]) & mutating[None, :]
+            clear = _bool_matmul(row_op, u_clear).reshape(b * d, k, cap)
+            refr = _bool_matmul(row_op, u_refresh).reshape(b * d, k, cap)
+        else:
+            # bucket table: non-mutating ops scatter all-false vectors
+            # into the sentinel row, which dummy/unmutated rows then read
+            # back as zeros — identical to the masked matmul
+            u2 = jnp.stack([u_clear, u_refresh], axis=1).astype(I32)
+            tbl = (
+                jnp.zeros((ecfg.mb_table_buckets + 1, 2, k * cap), I32)
+                .at[jnp.minimum(eff_idx, m_sentinel)]
+                .add(u2)
+            )
+            agg = tbl[jnp.minimum(rows_idx, m_sentinel)] > 0
+            clear = agg[:, 0].reshape(b * d, k, cap)
+            refr = agg[:, 1].reshape(b * d, k, cap)
 
         rows_entries = entries_c.reshape(b * d, k, cap, ENTRY_WORDS)
         rows_keys = keys_c.reshape(b * d, k, 8)

@@ -38,6 +38,15 @@ from .pallas_cipher import keystream_tile
 
 U32 = jnp.uint32
 
+#: HBM-resident ref memory space across the pallas-tpu API rename:
+#: newer jax exposes ``pltpu.MemorySpace.HBM``; older releases spell
+#: the same "leave it in HBM, kernel DMAs tiles itself" contract
+#: ``TPUMemorySpace.ANY`` (the idiom all the manual-DMA examples of
+#: that era used). getattr keeps the new name authoritative when
+#: present, so TPU-validated behavior is unchanged there.
+_MS = getattr(pltpu, "MemorySpace", None)
+HBM = _MS.HBM if _MS is not None else pltpu.TPUMemorySpace.ANY
+
 
 def _gather_kernel(
     bucket_ref,  # scalar-prefetch: u32[R] row indices (the public path)
@@ -229,9 +238,9 @@ def gather_decrypt_rows_tiled(
         grid=(r_pad // tile,),
         in_specs=[
             pl.BlockSpec((1, 8), lambda i, b_ref: (0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
         ],
         out_specs=[
             pl.BlockSpec((tile, z), lambda i, b_ref: (i, 0)),
@@ -391,14 +400,14 @@ def scatter_encrypt_rows_tiled(
             pl.BlockSpec((tile, z), lambda i, b_ref: (i, 0)),
             pl.BlockSpec((tile, zv), lambda i, b_ref: (i, 0)),
             pl.BlockSpec((1, 2), lambda i, b_ref: (0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
+            pl.BlockSpec(memory_space=HBM),
         ],
         scratch_shapes=[
             pltpu.VMEM((tile, z), U32),

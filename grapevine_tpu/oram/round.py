@@ -77,6 +77,9 @@ def occurrence_masks(idxs: jax.Array, dummy_index: int):
     this op's fresh leaf wins the position-map remap. chain_slot[i]: the
     slot of the round's first op on the same index (dummies get their own
     slot) — the shared chain-buffer slot for within-round read-after-write.
+
+    The classic [B,B]-mask form; `occurrence_masks_sorted` computes the
+    identical masks in O(B log B) for the scan engine.
     """
     is_real = idxs != U32(dummy_index)
     eq = (idxs[:, None] == idxs[None, :]) & is_real[:, None] & is_real[None, :]
@@ -86,6 +89,24 @@ def occurrence_masks(idxs: jax.Array, dummy_index: int):
     last_occ = is_real & ~jnp.any(eq & earlier.T, axis=1)
     slot_iota = jnp.arange(b, dtype=U32)
     chain_slot = jnp.where(is_real, jnp.argmax(eq, axis=1).astype(U32), slot_iota)
+    return first_occ, last_occ, chain_slot
+
+
+def occurrence_masks_sorted(idxs: jax.Array, dummy_index: int):
+    """`occurrence_masks` in O(B log B): one sort by (index, slot), then
+    segment boundaries in sorted order mark first/last occurrences — no
+    [B,B] intermediate (bit-identical outputs; tests/test_round.py)."""
+    from ..oblivious.segmented import multiword_group_sort, segment_bounds
+
+    b = idxs.shape[0]
+    is_real = idxs != U32(dummy_index)
+    slot_iota = jnp.arange(b, dtype=U32)
+    perm, inv, seg_start = multiword_group_sort([idxs])
+    start, end = segment_bounds(seg_start)
+    iota_i = jnp.arange(b, dtype=jnp.int32)
+    first_occ = is_real & ((iota_i == start)[inv])
+    last_occ = is_real & ((iota_i == end)[inv])
+    chain_slot = jnp.where(is_real, perm[start][inv], slot_iota)
     return first_occ, last_occ, chain_slot
 
 
@@ -117,6 +138,7 @@ def oram_round(
     dummy_leaves: jax.Array,  # u32[B] fresh uniform leaves (dummy fetches)
     apply_batch,
     axis_name: str | None = None,
+    occ_impl: str = "dense",
 ):
     """One batched oblivious access round over this ORAM.
 
@@ -135,6 +157,10 @@ def oram_round(
 
     Returns ``(state', outs, leaves)``; ``leaves`` u32[B] is the public
     transcript (every entry an independent uniform draw).
+
+    ``occ_impl``: "dense" = [B,B]-mask dedup, "scan" = sorted dedup with
+    no quadratic intermediate (bit-identical; matches the engine's
+    ``vphases_impl`` knob).
     """
     b = idxs.shape[0]
     z, v, plen, h = cfg.bucket_slots, cfg.value_words, cfg.path_len, cfg.height
@@ -142,7 +168,8 @@ def oram_round(
     nslots = b * plen * z
 
     # --- 1. dedup, position-map read/remap, path fetch -----------------
-    first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
+    occ = occurrence_masks_sorted if occ_impl == "scan" else occurrence_masks
+    first_occ, last_occ, _ = occ(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
     # last occurrence wins the remap; others drop out of bounds (the
     # dummy slot posmap[blocks] is never read unmasked, so funneling

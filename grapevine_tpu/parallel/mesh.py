@@ -40,6 +40,18 @@ from ..oram.path_oram import OramState
 #: mesh axis across which the bucket trees are sharded
 TREE_AXIS = "tree"
 
+# shard_map across the API move: newer jax exposes ``jax.shard_map``
+# (replication check spelled ``check_vma``); older releases ship it as
+# ``jax.experimental.shard_map.shard_map`` with ``check_rep``. Same
+# semantics either way; the new name stays authoritative when present.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_NOCHECK = {"check_vma": False}
+else:  # pragma: no cover - exercised only on older jaxlibs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_NOCHECK = {"check_rep": False}
+
 
 def make_mesh(devices=None) -> Mesh:
     """1-D mesh over the given (default: all) devices."""
@@ -120,11 +132,11 @@ def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     .github/workflows/ci.yaml:15-16).
     """
     specs = engine_state_specs()
-    step = jax.shard_map(
+    step = _shard_map(
         functools.partial(engine_round_step, ecfg, axis_name=TREE_AXIS),
         mesh=mesh,
         in_specs=(specs, P()),
         out_specs=(specs, P(), P()),
-        check_vma=False,
+        **_SHARD_MAP_NOCHECK,
     )
     return jax.jit(step, donate_argnums=0)

@@ -60,3 +60,61 @@ def test_scatter_kernel_lowers_for_tpu(fn):
              jax.ShapeDtypeStruct((r,), jnp.bool_), _s(2), _s(r, z),
              _s(r, z * v)]
     _lower_tpu(fn, *specs, z=z, rounds=8, interpret=False)
+
+
+# ----------------------------------------------------------------------
+# the whole phase-major engine round, per vphases impl: the sort/scan
+# path (variadic lax.sort, associative scans, cummax/cummin, scatter
+# tables) must lower for TPU cross-platform just like the Pallas
+# kernels — a scan geometry that only ever ran on CPU would repeat the
+# window-1 lowering surprise at the first vphases_perf A/B.
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "impl,geom",
+    [
+        # (batch, max_messages, max_recipients, mailbox_cap, density);
+        # scan gets both geometries (the new, never-TPU-compiled path),
+        # dense one (it already compiled on the real chip in window 1)
+        ("scan", (8, 64, 8, 4, 2)),
+        ("scan", (16, 1 << 10, 1 << 6, 62, 4)),  # production-shaped
+        ("dense", (8, 64, 8, 4, 2)),
+    ],
+)
+def test_engine_round_lowers_for_tpu(impl, geom):
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.round_step import engine_round_step
+    from grapevine_tpu.engine.state import (
+        EngineConfig,
+        ID_WORDS,
+        KEY_WORDS,
+        PAYLOAD_WORDS,
+        init_engine,
+    )
+
+    b, cap, recips, mcap, density = geom
+    cfg = GrapevineConfig(
+        max_messages=cap,
+        max_recipients=recips,
+        mailbox_cap=mcap,
+        batch_size=b,
+        tree_density=density,
+        bucket_cipher_rounds=8,
+        vphases_impl=impl,
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    state = jax.eval_shape(lambda: init_engine(ecfg, 0))
+    batch = {
+        "req_type": _s(b),
+        "auth": _s(b, KEY_WORDS),
+        "msg_id": _s(b, ID_WORDS),
+        "recipient": _s(b, KEY_WORDS),
+        "payload": _s(b, PAYLOAD_WORDS),
+        "now": _s(),
+        "now_hi": _s(),
+    }
+    export.export(
+        jax.jit(functools.partial(engine_round_step, ecfg)),
+        platforms=("tpu",),
+    )(state, batch)

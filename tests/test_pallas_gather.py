@@ -8,6 +8,7 @@ TPU by bench.py's pallas configs)."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import GrapevineEngine
@@ -96,6 +97,10 @@ def _run_crd(impl: str, seed: int = 9):
     return outs, e.state
 
 
+@pytest.mark.slow  # the repo's single fattest test (~66 s interpret-mode
+# e2e over three cipher impls); the kernel-level equality tests above
+# stay always-on and the TPU capture's mosaic stage re-proves this
+# contract on device — moved off the tier-1 budget in PR 3
 def test_engine_round_identical_across_cipher_impls():
     """Full engine C-R-D through the fused fetch ≡ the jnp path: same
     seed ⇒ same ids, payloads, statuses, AND bit-identical state up to
@@ -186,6 +191,8 @@ def test_tiled_scatter_matches_encrypt_then_scatter():
             assert np.array_equal(on[row], orig_n[row]), f"non {row}"
 
 
+@pytest.mark.slow  # interpret-mode engine round: ~26 s; kernel-level
+# tiled equality stays in tier-1 (test_tiled_*), this e2e pass is -m slow
 def test_engine_round_identical_tiled_impl():
     """Same contract for the tiled fused impl (manual-DMA kernels)."""
     from grapevine_tpu.testing.compare import states_equal_excluding_junk
@@ -197,6 +204,8 @@ def test_engine_round_identical_tiled_impl():
     assert same, f"state diverges at {first_diff}"
 
 
+@pytest.mark.slow  # 8-virtual-device compile ~25 s; sharded equality
+# coverage in tier-1 budget lives in tests/test_parallel.py's fast params
 def test_sharded_path_ignores_fused_fetch():
     """Under shard_map (axis_name set) the fused fetch must NOT engage —
     the sharded program still compiles and matches single-chip (the

@@ -51,7 +51,18 @@ def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
 
 @pytest.mark.parametrize(
     "cipher_rounds,n_dev,impl",
-    [(0, 8, "jnp"), (8, 8, "jnp"), (0, 2, "jnp"), (8, 4, "jnp"), (8, 8, "pallas")],
+    [
+        # real equality cases, ~35 s each on the timesliced CPU mesh —
+        # they ride -m slow to keep tier-1 inside its 870 s budget
+        # (they only became runnable with the shard_map compat shim —
+        # before that the whole set failed at import-time attribute;
+        # run `pytest -m slow tests/test_parallel.py` for the sweep).
+        pytest.param(0, 2, "jnp", marks=pytest.mark.slow),
+        pytest.param(0, 8, "jnp", marks=pytest.mark.slow),
+        pytest.param(8, 8, "jnp", marks=pytest.mark.slow),
+        pytest.param(8, 4, "jnp", marks=pytest.mark.slow),
+        pytest.param(8, 8, "pallas", marks=pytest.mark.slow),
+    ],
 )
 def test_sharded_step_matches_single_chip(cipher_rounds, n_dev, impl):
     """Sharded ≡ single-chip at 2/4/8-way meshes, with the at-rest

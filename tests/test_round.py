@@ -23,7 +23,11 @@ from grapevine_tpu.oram.path_oram import (
     stash_occupancy,
     tree_occupancy,
 )
-from grapevine_tpu.oram.round import occurrence_masks, oram_round
+from grapevine_tpu.oram.round import (
+    occurrence_masks,
+    occurrence_masks_sorted,
+    oram_round,
+)
 from grapevine_tpu.testing.reference import ReferenceEngine
 from grapevine_tpu.wire import constants as C
 from grapevine_tpu.wire.records import QueryRequest, RequestRecord
@@ -184,6 +188,23 @@ def test_occurrence_masks():
     # [3,5,3,9,5,3,7]: same-key ops share the first occurrence's slot;
     # the dummy (9) keeps its own
     np.testing.assert_array_equal(np.asarray(chain), [0, 1, 0, 3, 1, 0, 6])
+
+
+def test_occurrence_masks_sorted_bit_identical():
+    """The O(B log B) dedup (scan engine) must match the [B,B] form on
+    random index streams with duplicates and dummies, including B=1."""
+    rng = np.random.default_rng(17)
+    sizes = [1, 2, 5, 8, 16, 32]  # fixed shapes: bounded compile count
+    for trial in range(24):
+        b = sizes[trial % len(sizes)]
+        dummy = 64
+        idxs = rng.integers(0, 6, b).astype(np.uint32)
+        idxs[rng.random(b) < 0.25] = dummy
+        f1, l1, c1 = occurrence_masks(jnp.asarray(idxs), dummy)
+        f2, l2, c2 = occurrence_masks_sorted(jnp.asarray(idxs), dummy)
+        np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2), trial)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2), trial)
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2), trial)
 
 
 # ---- phase-major engine vs oracle -------------------------------------

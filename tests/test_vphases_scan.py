@@ -1,0 +1,382 @@
+"""Dense-vs-scan vphases equivalence: bit-identical engines, no [B,B].
+
+The tentpole contract of the scan slot-order machinery
+(engine/vphases.py, ``vphases_impl="scan"``):
+
+1. responses AND final engine state bit-identical to the dense impl —
+   randomized oracle campaigns over op mixes heavy in same-key chains,
+   zero-id pops, saturation-fallback rounds, and single-op batches
+   (the same contract the cipher impls carry, testing/compare.py);
+2. the scan impl's jaxpr materializes NO [B,B]-shaped intermediate at
+   B=256 (asserted on the traced jaxpr, with the dense impl as the
+   positive control proving the checker sees such intermediates).
+
+The fast campaign count keeps tier-1 within budget; the full ≥200-
+campaign sweep runs under ``-m slow`` (and was run at PR time — see
+PERF.md Round 6). Set $GRAPEVINE_VPHASES_CAMPAIGNS to override.
+"""
+
+import functools
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.engine.round_step import engine_round_step
+from grapevine_tpu.engine.state import (
+    EngineConfig,
+    ID_WORDS,
+    KEY_WORDS,
+    PAYLOAD_WORDS,
+    init_engine,
+)
+from grapevine_tpu.testing.reference import ReferenceEngine
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW = 1_700_000_000
+
+BASE = dict(
+    bucket_cipher_rounds=0,
+    max_messages=64,
+    max_recipients=8,
+    mailbox_cap=4,
+    batch_size=8,
+    stash_size=96,
+)
+#: bus within B of full from the start (free_top < B after one round of
+#: creates) — every later round takes the _admission_slow lax.scan
+#: branch; mailbox_cap raised so the bus quota binds before the
+#: per-recipient cap
+SAT_BUS = dict(BASE, max_messages=16, mailbox_cap=16)
+#: recipient table can never cover a full batch (recipients0 + B > max)
+#: — the slow branch runs from round one
+SAT_RECIP = dict(BASE, max_recipients=4)
+
+
+def key(n: int) -> bytes:
+    return bytes([n & 0xFF, (n >> 8) ^ 0x5A]) + b"\x01" * 30
+
+
+def req(rt, auth, msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY, tag=0):
+    return QueryRequest(
+        request_type=rt,
+        auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+        record=RequestRecord(
+            msg_id=msg_id,
+            recipient=recipient,
+            payload=bytes([tag & 0xFF]) * C.PAYLOAD_SIZE,
+        ),
+    )
+
+
+def _mk_pair(cfg_kwargs, seed):
+    dense = GrapevineEngine(
+        GrapevineConfig(vphases_impl="dense", **cfg_kwargs), seed=seed
+    )
+    scan = GrapevineEngine(
+        GrapevineConfig(vphases_impl="scan", **cfg_kwargs), seed=seed
+    )
+    return dense, scan
+
+
+def _assert_responses_bitequal(rd, rs, ctx=""):
+    for j, (d, s) in enumerate(zip(rd, rs)):
+        assert d.status_code == s.status_code, f"{ctx} slot {j}: status"
+        assert d.record.msg_id == s.record.msg_id, f"{ctx} slot {j}: id"
+        assert d.record.sender == s.record.sender, f"{ctx} slot {j}: sender"
+        assert d.record.recipient == s.record.recipient, f"{ctx} slot {j}"
+        assert d.record.timestamp == s.record.timestamp, f"{ctx} slot {j}: ts"
+        assert d.record.payload == s.record.payload, f"{ctx} slot {j}: payload"
+
+
+def _assert_states_bitequal(ea, eb, ctx=""):
+    la = jax.tree_util.tree_leaves_with_path(ea.state)
+    lb = jax.tree_util.tree_leaves(eb.state)
+    for (path, x), y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{ctx}: state diverges at {jax.tree_util.keystr(path)}"
+        )
+
+
+def _gen_batch(rng, idents, live_ids, n):
+    """Op mix heavy in same-key chains and zero-id pops; explicit-id
+    R/U/D drawn from live ids (stale ids → NOT_FOUND, also exercised)."""
+    reqs = []
+    for _ in range(n):
+        r = rng.random()
+        a = idents[rng.integers(len(idents))]
+        x = idents[rng.integers(len(idents))]
+        if r < 0.30:
+            reqs.append(
+                req(C.REQUEST_TYPE_CREATE, a, recipient=x,
+                    tag=int(rng.integers(256)))
+            )
+        elif r < 0.34:  # zero recipient → INVALID_RECIPIENT
+            reqs.append(req(C.REQUEST_TYPE_CREATE, a))
+        elif r < 0.55:
+            reqs.append(req(C.REQUEST_TYPE_READ, a))  # zero-id pop-read
+        elif r < 0.72:
+            reqs.append(req(C.REQUEST_TYPE_DELETE, a))  # zero-id pop
+        elif live_ids and r < 0.82:
+            mid, owner = live_ids[rng.integers(len(live_ids))]
+            reqs.append(req(C.REQUEST_TYPE_READ, a, msg_id=mid))
+        elif live_ids and r < 0.92:
+            mid, owner = live_ids[rng.integers(len(live_ids))]
+            rcp = owner if rng.random() < 0.7 else x
+            reqs.append(
+                req(C.REQUEST_TYPE_UPDATE, owner, msg_id=mid, recipient=rcp,
+                    tag=int(rng.integers(256)))
+            )
+        elif live_ids:
+            mid, owner = live_ids[rng.integers(len(live_ids))]
+            reqs.append(
+                req(C.REQUEST_TYPE_DELETE, owner, msg_id=mid, recipient=owner)
+            )
+        else:
+            reqs.append(req(C.REQUEST_TYPE_READ, x))
+    return reqs
+
+
+def _run_campaign(cfg_kwargs, seed, n_batches=3, batch_fill=None):
+    """One campaign: fresh dense/scan engines + oracle, mixed batches.
+
+    Asserts dense ≡ scan bitwise (responses, then final state) and both
+    ≡ oracle semantics (forced-id comparison, counts included).
+    """
+    rng = np.random.default_rng(seed)
+    dense, scan = _mk_pair(cfg_kwargs, seed=int(rng.integers(1 << 30)))
+    oracle = ReferenceEngine(
+        config=GrapevineConfig(**cfg_kwargs), rng=random.Random(seed)
+    )
+    idents = [key(i) for i in range(1, 1 + int(rng.integers(2, 6)))]
+    live_ids: list[tuple[bytes, bytes]] = []
+    bs = cfg_kwargs["batch_size"]
+    for bi in range(n_batches):
+        n = batch_fill or int(rng.integers(1, bs + 1))
+        reqs = _gen_batch(rng, idents, live_ids, n)
+        t = NOW + bi
+        rd = dense.handle_queries(reqs, t)
+        rs = scan.handle_queries(reqs, t)
+        _assert_responses_bitequal(rd, rs, f"seed {seed} batch {bi}")
+        forced = [
+            d.record.msg_id
+            if r.request_type == C.REQUEST_TYPE_CREATE
+            and d.status_code == C.STATUS_CODE_SUCCESS
+            else None
+            for r, d in zip(reqs, rd)
+        ]
+        ro = oracle.handle_batch(reqs, t, forced)
+        for j, (r, d, o) in enumerate(zip(reqs, rd, ro)):
+            assert d.status_code == o.status_code, (
+                f"seed {seed} batch {bi} slot {j}: engine "
+                f"{d.status_code} != oracle {o.status_code}"
+            )
+            assert d.record.msg_id == o.record.msg_id
+            assert d.record.payload == o.record.payload
+            assert d.record.timestamp == o.record.timestamp
+        assert dense.message_count() == oracle.message_count()
+        assert dense.recipient_count() == oracle.recipient_count()
+        for r, d in zip(reqs, rd):
+            if (
+                r.request_type == C.REQUEST_TYPE_CREATE
+                and d.status_code == C.STATUS_CODE_SUCCESS
+            ):
+                live_ids.append((d.record.msg_id, r.record.recipient))
+            elif (
+                r.request_type == C.REQUEST_TYPE_DELETE
+                and d.status_code == C.STATUS_CODE_SUCCESS
+            ):
+                live_ids = [
+                    (m, o_) for m, o_ in live_ids if m != d.record.msg_id
+                ]
+    _assert_states_bitequal(dense, scan, f"seed {seed}")
+
+
+def _campaign_plan(n_total):
+    """Distribute campaigns over the regimes; every regime represented."""
+    plans = []
+    for i in range(n_total):
+        r = i % 10
+        if r < 5:
+            plans.append((BASE, None))  # steady-state fast path
+        elif r < 7:
+            plans.append((SAT_BUS, None))  # bus saturation fallback
+        elif r < 9:
+            plans.append((SAT_RECIP, None))  # recipient-table fallback
+        else:
+            plans.append((BASE, 1))  # single-op batches (dummy-padded)
+    return plans
+
+
+_FAST_N = int(os.environ.get("GRAPEVINE_VPHASES_CAMPAIGNS", "8"))
+
+
+def test_randomized_ab_campaigns():
+    """Budget-shaped fast set: the cost is ~all jit compiles (one per
+    distinct geometry × impl), so the fast plan spans two geometries —
+    steady-state and bus-saturation. Both saturation regimes resolve
+    through the same _admission_slow scan (only the tripping guard
+    differs), so bus-saturation keeps the fallback branch covered; the
+    recipient-table geometry runs in the -m slow full sweep."""
+    for i, (cfg, fill) in enumerate(_campaign_plan(_FAST_N)):
+        if cfg is SAT_RECIP:
+            cfg = SAT_BUS
+        _run_campaign(cfg, seed=1000 + i, batch_fill=fill)
+
+
+@pytest.mark.slow
+def test_randomized_ab_campaigns_full():
+    """The full ≥200-campaign acceptance sweep (run at PR time; kept
+    under -m slow so tier-1 stays within its budget)."""
+    for i, (cfg, fill) in enumerate(_campaign_plan(220)):
+        _run_campaign(cfg, seed=5000 + i, batch_fill=fill)
+
+
+@pytest.mark.slow  # two extra engine compiles (~15 s); the B=1 segment
+# edge cases are covered always-on by the segmented property tests and
+# the fill=1 campaigns in the fast plan
+def test_single_op_batch_engine_ab():
+    """batch_size=1 end to end: the sort/scan machinery at B=1 (segment
+    logic degenerate cases) stays bit-identical and oracle-true."""
+    cfg = dict(BASE, batch_size=1)
+    for i in range(6):
+        _run_campaign(cfg, seed=300 + i, n_batches=6, batch_fill=1)
+
+
+def test_saturation_fallback_engaged_and_bitequal():
+    """Drive the bus to saturation so fast_ok is False (free_top < B):
+    rounds resolve through _admission_slow under both impls and must
+    stay bit-identical, including TOO_MANY_MESSAGES admission order."""
+    dense, scan = _mk_pair(SAT_BUS, seed=9)
+    a, x = key(1), key(2)
+    t = NOW
+    # 3 full batches of creates against max_messages=16: round 2 onward
+    # runs with free_top < B=8 → the lax.scan branch
+    for bi in range(3):
+        reqs = [
+            req(C.REQUEST_TYPE_CREATE, a, recipient=x, tag=bi * 8 + j)
+            for j in range(8)
+        ]
+        rd = dense.handle_queries(reqs, t + bi)
+        rs = scan.handle_queries(reqs, t + bi)
+        _assert_responses_bitequal(rd, rs, f"sat batch {bi}")
+    assert dense.message_count() <= 16
+    codes = {r.status_code for r in rd}
+    assert C.STATUS_CODE_TOO_MANY_MESSAGES in codes  # quota actually hit
+    _assert_states_bitequal(dense, scan, "saturation")
+
+
+# ----------------------------------------------------------------------
+# jaxpr shape audit: the scan impl materializes no [B,B] intermediate
+# ----------------------------------------------------------------------
+
+JAXPR_B = 256
+
+
+def _iter_jaxprs(jaxpr):
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                inner = getattr(x, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from _iter_jaxprs(inner)
+                elif hasattr(x, "eqns"):
+                    yield from _iter_jaxprs(x)
+
+
+def _quadratic_avals(jaxpr, b):
+    """All bool/f32 avals in the jaxpr with ≥2 axes of extent ≥ b.
+
+    Record values are u32[B, 256] at the 1KB record size — exactly B
+    words wide at B=256 — so a ``jnp.where(mask[:, None], rows, ...)``
+    over record rows carries a broadcast bool predicate of shape
+    (B, 256) that is batch×value-width, not a same-key matrix. Those
+    two representational primitives (the predicate broadcast and the
+    select it feeds) are excluded for bools; every *computational* use
+    of a genuine [B,B] mask (and/or/reduce/convert, and the f32 one-hot
+    matmul operands) remains audited, which the dense positive-control
+    test proves is sufficient to detect the dense impl.
+    """
+    bad = []
+    skip_bool = ("select_n", "broadcast_in_dim")
+    for jx in _iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                shape = getattr(aval, "shape", ())
+                dtype = getattr(aval, "dtype", None)
+                if dtype is None:
+                    continue
+                if dtype not in (jnp.bool_, jnp.float32):
+                    continue
+                if dtype == jnp.bool_ and eqn.primitive.name in skip_bool:
+                    continue
+                if sum(1 for dim in shape if dim >= b) >= 2:
+                    bad.append((eqn.primitive.name, str(dtype), tuple(shape)))
+    return bad
+
+
+def _trace_engine_jaxpr(impl):
+    cfg = GrapevineConfig(
+        max_messages=1 << 12,
+        max_recipients=1 << 8,
+        mailbox_cap=4,
+        batch_size=JAXPR_B,
+        bucket_cipher_rounds=0,
+        stash_size=512,
+        vphases_impl=impl,
+    )
+    ecfg = EngineConfig.from_config(cfg)
+    state = jax.eval_shape(lambda: init_engine(ecfg, 0))
+    b = JAXPR_B
+    u32 = jnp.uint32
+    batch = {
+        "req_type": jax.ShapeDtypeStruct((b,), u32),
+        "auth": jax.ShapeDtypeStruct((b, KEY_WORDS), u32),
+        "msg_id": jax.ShapeDtypeStruct((b, ID_WORDS), u32),
+        "recipient": jax.ShapeDtypeStruct((b, KEY_WORDS), u32),
+        "payload": jax.ShapeDtypeStruct((b, PAYLOAD_WORDS), u32),
+        "now": jax.ShapeDtypeStruct((), u32),
+        "now_hi": jax.ShapeDtypeStruct((), u32),
+    }
+    return jax.make_jaxpr(functools.partial(engine_round_step, ecfg))(
+        state, batch
+    ).jaxpr
+
+
+def test_scan_jaxpr_has_no_quadratic_intermediate():
+    bad = _quadratic_avals(_trace_engine_jaxpr("scan"), JAXPR_B)
+    assert not bad, (
+        f"scan impl materializes quadratic mask intermediates at "
+        f"B={JAXPR_B}: {sorted(set(bad))[:8]}"
+    )
+
+
+def test_dense_jaxpr_audit_positive_control():
+    """The dense impl DOES materialize [B,B] masks — proving the audit
+    actually detects the intermediates the scan test asserts away."""
+    bad = _quadratic_avals(_trace_engine_jaxpr("dense"), JAXPR_B)
+    assert bad, "audit found no [B,B] intermediates even in the dense impl"
+
+
+def test_vphases_impl_knob_validation():
+    with pytest.raises(ValueError):
+        GrapevineConfig(vphases_impl="bogus")
+    # None resolves per backend at engine-config time; tests force CPU
+    ecfg = EngineConfig.from_config(GrapevineConfig())
+    assert ecfg.vphases_impl == "scan"
+    assert (
+        EngineConfig.from_config(
+            GrapevineConfig(vphases_impl="dense")
+        ).vphases_impl
+        == "dense"
+    )

@@ -13,6 +13,9 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
                  pallas_fused ON TPU (first real Mosaic compile of all
                  three kernels)
 4. pallas_perf — zipf_pallas_cipher + zipf_pallas_fused at full size
+4b. vphases_perf — dense vs scan slot-order machinery A/B (decides the
+                 per-backend vphases_impl default, incl. the B=4096
+                 dense-memory-wall probe)
 5. oblivious   — transcript equality + R/U/D timing z-scores from
                  TPU-executed rounds (tiny capacity; it is the compiled
                  schedule being tested, not scale)
@@ -78,9 +81,12 @@ def stage_probe(cap, args):
         raise RuntimeError(f"not a TPU backend: {jax.default_backend()!r}")
 
 
-def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds):
+def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
+              vphases=None):
     """zipf_mixed through a chosen cipher impl at a chosen size, using
-    bench.py's own machinery (same methodology as the driver bench)."""
+    bench.py's own machinery (same methodology as the driver bench).
+    ``vphases`` selects the slot-order machinery ("dense"/"scan"; None =
+    the backend default)."""
     import jax
     import numpy as np
 
@@ -88,7 +94,8 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds):
 
     t0 = time.perf_counter()
     cfg, ecfg, state, step = bench._mk_engine(
-        1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl
+        1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl,
+        vphases_impl=vphases,
     )
     batches = bench.make_batches(4, batch)
     compile_t0 = time.perf_counter()
@@ -97,7 +104,8 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds):
     compile_s = time.perf_counter() - compile_t0
     _, times, total = bench._run_rounds(ecfg, state, step, batches[1:], n_rounds)
     ops = batch * n_rounds
-    cap.emit(stage_name, impl=impl, capacity_log2=cap_log2, batch=batch,
+    cap.emit(stage_name, impl=impl, vphases=ecfg.vphases_impl,
+             capacity_log2=cap_log2, batch=batch,
              rounds=n_rounds, ops_per_sec=round(ops / total, 1),
              p99_round_ms=round(bench._p99(times), 2),
              median_round_ms=round(float(np.median(times)) * 1e3, 3),
@@ -257,6 +265,26 @@ def stage_pallas_perf(cap, args):
     _zipf_run(cap, "pallas_perf", "pallas", cl, b, 8)
 
 
+def stage_vphases_perf(cap, args):
+    """Dense vs scan slot-order machinery at headline geometry ON TPU —
+    the A/B that decides the per-backend ``vphases_impl`` default
+    (config.py; currently dense-on-TPU on the theory that the MXU eats
+    the [B,B] masks and one-hot matmuls). Mirrors ``pallas_perf``:
+    identical workload, the knob is the only difference, and the two
+    impls are bit-identical (tests/test_vphases_scan.py) so whichever
+    is faster simply wins. Also the B=4096 probe: the dense masks at
+    B=4096 cost ~1.1 GB of [B,B]-shaped intermediates per round
+    (PERF.md Round 6 memory math) — if dense OOMs or cliffs there while
+    scan runs, that alone decides the large-B default."""
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "vphases_perf", "jnp", cl, b, 8, vphases="dense")
+    _zipf_run(cap, "vphases_perf", "jnp", cl, b, 8, vphases="scan")
+    if not args.quick:
+        # the unlock question: scan at the batch size dense pins
+        _zipf_run(cap, "vphases_perf", "jnp", 20, 4096, 8, vphases="scan")
+        _zipf_run(cap, "vphases_perf", "jnp", 20, 4096, 8, vphases="dense")
+
+
 def stage_oblivious(cap, args):
     """SURVEY §7 hard-part 2 on the real device: R/U/D transcript
     equality + timing uniformity, reusing the CPU suite's EXACT
@@ -368,6 +396,7 @@ STAGES = [
     # window proved windows can close in minutes
     ("trace", stage_trace, 900),
     ("pallas_perf", stage_pallas_perf, 1800),
+    ("vphases_perf", stage_vphases_perf, 1800),
     ("oblivious", stage_oblivious, 900),
     ("fullbench", None, 2400),  # subprocess-only (see main loop)
 ]
