@@ -20,7 +20,8 @@ import time
 import jax
 import numpy as np
 
-from ..config import GrapevineConfig
+from ..config import DurabilityConfig, GrapevineConfig
+from ..testing import faults
 from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire.records import QueryRequest, QueryResponse, Record
@@ -175,7 +176,8 @@ class GrapevineEngine:
     expiry timer calls ``expire``.
     """
 
-    def __init__(self, config: GrapevineConfig | None = None, seed: int = 0):
+    def __init__(self, config: GrapevineConfig | None = None, seed: int = 0,
+                 durability: DurabilityConfig | None = None):
         self.config = config or GrapevineConfig()
         self.ecfg = EngineConfig.from_config(self.config)
         self.state: EngineState = init_engine(self.ecfg, seed)
@@ -192,6 +194,47 @@ class GrapevineEngine:
         #: streaming obliviousness auditor (obs/leakmon.py), attached by
         #: the serving layer when --leakmon is on; None = no monitoring
         self.leakmon = None
+        #: crash safety (engine/checkpoint.py): with a DurabilityConfig,
+        #: every admitted batch is journaled before dispatch and the
+        #: whole state checkpointed every N records; construction runs
+        #: recovery (checkpoint load + deterministic journal replay), so
+        #: a freshly built engine already holds the pre-crash state
+        self.durability = None
+        if durability is not None:
+            from .checkpoint import DurabilityManager
+
+            self.durability = DurabilityManager(
+                durability, self.ecfg, registry=self.metrics.registry
+            )
+            with self.metrics.time_phase("replay"):
+                self.state = self.durability.recover(
+                    self.state, self._replay_record
+                )
+                jax.block_until_ready(self.state.free_top)
+
+    def _replay_record(self, state: EngineState, rec) -> EngineState:
+        """Apply one journal record through the same jitted programs the
+        live path uses — replay IS re-execution, so recovered state is
+        bit-identical by the engine's own determinism."""
+        from .journal import KIND_ROUND
+
+        if rec.kind == KIND_ROUND:
+            state, _resp, _transcript = self._step(self.ecfg, state, rec.batch)
+            return state
+        return self._sweep(
+            self.ecfg, state,
+            np.uint32(rec.now), np.uint32(rec.period), np.uint32(rec.now_hi),
+        )
+
+    def checkpoint_now(self) -> int | None:
+        """Force a sealed checkpoint of the current state (the drain
+        path: scheduler settled → checkpoint → exit). No-op returning
+        None without durability."""
+        if self.durability is None:
+            return None
+        with self._lock:
+            with self.metrics.time_phase("checkpoint"):
+                return self.durability.checkpoint(self.state)
 
     def attach_leakmon(self, monitor) -> None:
         """Attach an EngineLeakMonitor; subsequent rounds hand their
@@ -239,14 +282,31 @@ class GrapevineEngine:
         lm = self.leakmon
         with self._lock:
             # "dispatch" = host pack + async device enqueue (JAX returns
-            # at enqueue; the device round itself lands in "evict")
+            # at enqueue; the device round itself lands in "evict").
+            # With durability on it also spans the journal barrier —
+            # append-before-dispatch is the crash-safety contract, and
+            # its fsync is genuinely part of the commit latency (the
+            # "journal" series isolates it).
             t_d0 = time.perf_counter()
             with self.metrics.time_phase("dispatch"):
                 batch = pack_batch(reqs, bs, now)
+                if self.durability is not None:
+                    t_j0 = time.perf_counter()
+                    self.durability.append_round(batch, len(reqs))
+                    self.metrics.observe_phase(
+                        "journal", time.perf_counter() - t_j0
+                    )
                 t0 = time.perf_counter()
                 self.state, resp, transcript = self._step(
                     self.ecfg, self.state, batch
                 )
+            if faults.active():
+                faults.crash("round.post_dispatch")
+            if self.durability is not None and self.durability.should_checkpoint():
+                # blocks this round's slot until the sealed state is on
+                # disk — the RTO/RPO trade --checkpoint-every-rounds buys
+                with self.metrics.time_phase("checkpoint"):
+                    self.durability.checkpoint(self.state)
             dispatch_s = time.perf_counter() - t_d0
         if lm is None:
             return PendingRound(self, resp, len(reqs), t0)
@@ -271,6 +331,8 @@ class GrapevineEngine:
             raise ValueError("single batch only")
         with self._lock:
             batch = pack_batch(reqs, bs, now)
+            if self.durability is not None:  # same contract as the async path
+                self.durability.append_round(batch, len(reqs))
             self.state, resp, transcript = self._step(self.ecfg, self.state, batch)
             return unpack_responses(resp, len(reqs)), np.asarray(transcript)
 
@@ -281,6 +343,13 @@ class GrapevineEngine:
             return 0
         with self._lock:
             before = int(self.state.free_top)
+            if self.durability is not None:
+                # journal-before-mutate, same as rounds: a crash between
+                # append and apply replays the sweep (apply ≡ replay)
+                self.durability.append_sweep(
+                    int(now) & 0xFFFFFFFF, (int(now) >> 32) & 0xFFFFFFFF,
+                    int(period),
+                )
             with self.metrics.time_phase("sweep"):
                 self.state = self._sweep(
                     self.ecfg,
@@ -292,7 +361,19 @@ class GrapevineEngine:
                 jax.block_until_ready(self.state.free_top)
             evicted = int(self.state.free_top) - before
             self.metrics.record_sweep(evicted)
+            if self.durability is not None and self.durability.should_checkpoint():
+                # sweeps count against the cadence like rounds do — an
+                # idle server with expiry on must not grow the journal
+                # (and its replay-time RTO) without bound
+                with self.metrics.time_phase("checkpoint"):
+                    self.durability.checkpoint(self.state)
             return evicted
+
+    def close(self) -> None:
+        """Flush and close the durability store (if any)."""
+        if self.durability is not None:
+            with self._lock:
+                self.durability.close()
 
     # -- metrics (never keyed by client identity; SURVEY.md §5) ---------
 

@@ -77,6 +77,9 @@ class EngineMetrics:
         self._c_stalls = r.counter(
             "grapevine_collector_stalls_total",
             "collection windows that hit the max_wait cap before filling")
+        self._c_worker_crash = r.counter(
+            "grapevine_worker_crash_total",
+            "scheduler collector thread deaths (crashes, not clean close)")
         self._g_occupancy = r.gauge(
             "grapevine_batch_occupancy",
             "real ops / batch slots of the last committed round")
@@ -142,6 +145,9 @@ class EngineMetrics:
 
     def record_stall(self) -> None:
         self._c_stalls.inc()
+
+    def record_worker_crash(self) -> None:
+        self._c_worker_crash.inc()
 
     # -- health probes --------------------------------------------------
 

@@ -160,6 +160,18 @@ def init_engine(ecfg: EngineConfig, seed: int = 0) -> EngineState:
     )
 
 
+def state_spec(ecfg: EngineConfig):
+    """Flattened leaf template of an EngineState for this geometry.
+
+    Returns ``(treedef, leaves)`` where ``leaves`` are ShapeDtypeStructs
+    in deterministic pytree order — the serialization contract
+    engine/checkpoint.py seals against. Computed with ``eval_shape`` so
+    no device arrays are materialized."""
+    tmpl = jax.eval_shape(lambda: init_engine(ecfg, 0))
+    leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+    return treedef, leaves
+
+
 def mb_parse(ecfg: EngineConfig, value: jax.Array):
     """Split a mailbox block value into (keys [K,8], entries [K,cap,4])."""
     k, cap = ecfg.mb_slots, ecfg.mailbox_cap

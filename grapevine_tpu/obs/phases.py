@@ -19,6 +19,9 @@ Host-side phases (histograms + ``jax.profiler`` annotations):
                   the host cannot time inside one XLA program)
 - ``demux``     — device→wire response unpacking
 - ``sweep``     — expiry sweep (engine/expiry.py)
+- ``journal``   — sealed batch-journal append + fsync (engine/journal.py)
+- ``checkpoint``— sealed whole-state checkpoint write (engine/checkpoint.py)
+- ``replay``    — startup journal replay (recovery; engine/batcher.py)
 
 Device-side scopes (``device_phase``): named_scope annotations compiled
 into the jit'd round so TPU profiler captures (tools/tpu_capture.py
@@ -32,7 +35,8 @@ import time
 
 #: canonical phase label values — the registry declares exactly these,
 #: so a typo'd phase name raises instead of minting a new series
-PHASES = ("assembly", "verify", "dispatch", "evict", "demux", "sweep")
+PHASES = ("assembly", "verify", "dispatch", "evict", "demux", "sweep",
+          "journal", "checkpoint", "replay")
 
 #: fixed histogram boundaries for phase durations (seconds). Spans the
 #: measured range: ~100 µs host phases at B=8 up to multi-second expiry

@@ -150,6 +150,46 @@ def build_parser() -> argparse.ArgumentParser:
         "transition (default: no automatic dump; /flightrec always "
         "serves the ring on demand)",
     )
+    p.add_argument(
+        "--state-dir",
+        help="crash safety: directory for sealed checkpoints + the "
+        "batch journal (engine/checkpoint.py). Every admitted batch is "
+        "journaled before dispatch; restart = last checkpoint + replay. "
+        "Default: off — state is volatile, exactly the pre-PR-4 "
+        "behavior (OPERATIONS.md §11). Device-owning roles only",
+    )
+    p.add_argument(
+        "--checkpoint-every-rounds",
+        type=int,
+        default=64,
+        help="(with --state-dir) rounds+sweeps between sealed "
+        "whole-state checkpoints — the RTO knob: recovery replays at "
+        "most this many journal records (default 64)",
+    )
+    p.add_argument(
+        "--journal-fsync-every",
+        type=int,
+        default=1,
+        help="(with --state-dir) journal records per fsync. 1 (default) "
+        "= every round is machine-crash-durable before it dispatches; "
+        "N>1 amortizes the fsync, risking the last N-1 acknowledged "
+        "rounds on power loss (process crashes lose nothing either way)",
+    )
+    p.add_argument(
+        "--seal-key-file",
+        help="(with --state-dir) 32-byte root seal key file (default: "
+        "<state-dir>/root.key, auto-generated 0600). Mount a secret "
+        "from outside the state volume in production — OPERATIONS.md "
+        "§11 key management",
+    )
+    p.add_argument(
+        "--worker-restart",
+        action="store_true",
+        help="supervised restart of the batch-collector thread after a "
+        "crash (default: a dead collector flips /healthz unhealthy and "
+        "stays dead for the orchestrator to replace the process). "
+        "Either way the crash increments grapevine_worker_crash_total",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -166,19 +206,60 @@ _LEAKMON_FLAGS = {"leakmon", "leakmon_window", "leakmon_uniformity_z",
                   "leakmon_collision_threshold",
                   "leakmon_repeat_threshold", "leakmon_dump_path"}
 
+#: durability owns device state, so only device-owning roles take it —
+#: a frontend supplying --state-dir would silently checkpoint nothing
+_DURABILITY_FLAGS = {"state_dir", "checkpoint_every_rounds",
+                     "journal_fsync_every", "seal_key_file",
+                     "worker_restart"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
-             "metrics_port", "metrics_host"} | _LEAKMON_FLAGS,
+             "metrics_port", "metrics_host"}
+            | _LEAKMON_FLAGS | _DURABILITY_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
                "seed", "verbose", "role", "metrics_port", "metrics_host"}
-              | _LEAKMON_FLAGS,
+              | _LEAKMON_FLAGS | _DURABILITY_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
 }
+
+
+def _durability_config(args):
+    """The DurabilityConfig for --state-dir, or None when off."""
+    if not args.state_dir:
+        return None
+    from ..config import DurabilityConfig
+
+    return DurabilityConfig(
+        state_dir=args.state_dir,
+        checkpoint_every_rounds=args.checkpoint_every_rounds,
+        journal_fsync_every=args.journal_fsync_every,
+        seal_key_file=args.seal_key_file,
+    )
+
+
+def _install_drain_handlers(drain):
+    """SIGTERM/SIGINT → drain (settle queued ops, finish the in-flight
+    round, seal a final checkpoint), then exit 0. Idempotent: a second
+    signal while draining is ignored rather than re-entering stop()."""
+    import signal
+    import threading
+
+    fired = threading.Event()
+
+    def _handler(signum, frame):
+        if fired.is_set():
+            return
+        fired.set()
+        drain()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
 
 
 def _leakmon_config(args):
@@ -255,7 +336,9 @@ def main(argv=None) -> int:
 
         engine = EngineServer(config, seed=args.seed,
                               max_wait_ms=args.batch_wait_ms,
-                              leakmon=_leakmon_config(args))
+                              leakmon=_leakmon_config(args),
+                              durability=_durability_config(args),
+                              worker_restart=args.worker_restart)
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
@@ -263,10 +346,14 @@ def main(argv=None) -> int:
             mport = engine.start_metrics(args.metrics_port,
                                          host=args.metrics_host)
             print(f"metrics endpoint on port {mport}", flush=True)
+        # drain-then-checkpoint on SIGTERM/SIGINT: queued ops settle
+        # with UNAVAILABLE, the in-flight round commits, the final
+        # state seals — restart loses nothing (OPERATIONS.md §11)
+        _install_drain_handlers(lambda: engine.stop(checkpoint=True))
         try:
             threading.Event().wait()
-        except KeyboardInterrupt:
-            engine.stop()
+        except KeyboardInterrupt:  # pragma: no cover - handler owns it
+            engine.stop(checkpoint=True)
         return 0
 
     if args.role == "frontend":
@@ -285,6 +372,8 @@ def main(argv=None) -> int:
         server = GrapevineServer(
             config, seed=args.seed, max_wait_ms=args.batch_wait_ms,
             identity=identity, leakmon=_leakmon_config(args),
+            durability=_durability_config(args),
+            worker_restart=args.worker_restart,
         )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None
@@ -295,9 +384,13 @@ def main(argv=None) -> int:
         print(f"metrics endpoint on port {mport}", flush=True)
     # the pinnable IX static (clients: GrapevineClient(server_static=...))
     print(f"server static key: {server.identity.public.hex()}", flush=True)
+    if args.role == "frontend":
+        _install_drain_handlers(server.stop)  # no engine state to seal
+    else:
+        _install_drain_handlers(lambda: server.stop(checkpoint=True))
     try:
         server.wait()
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # pragma: no cover - handler owns it
         server.stop()
     return 0
 

@@ -33,6 +33,13 @@ class AuthFailure(Exception):
     """The request's challenge signature did not verify."""
 
 
+class SchedulerShutdown(RuntimeError):
+    """The op was settled (or refused) because the scheduler is
+    draining: the explicit shutdown error clients get instead of a
+    silently dropped future. The serving layers map it to gRPC
+    UNAVAILABLE so clients retry elsewhere."""
+
+
 class BatchScheduler:
     def __init__(
         self,
@@ -41,6 +48,7 @@ class BatchScheduler:
         idle_gap_ms: float = 2.0,
         clock=None,
         scheme=None,
+        restart_on_crash: bool = False,
     ):
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
@@ -67,6 +75,17 @@ class BatchScheduler:
         self._inflight_since: float | None = None
         self._cv = threading.Condition()
         self._closed = False
+        #: explicit close() vs crash-closure: restart_on_crash revives
+        #: the collector only for the latter
+        self._shutdown = False
+        self._restart_on_crash = restart_on_crash
+        #: consecutive crashes without a successfully settled round in
+        #: between; past the cap the collector stays dead so /healthz
+        #: flips and the orchestrator replaces the process — supervised
+        #: restart must not convert a persistent fault (disk full,
+        #: wedged device) into a "healthy" server failing every request
+        self._crash_streak = 0
+        self.max_crash_streak = 8
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -81,7 +100,7 @@ class BatchScheduler:
         fut: Future = Future()
         with self._cv:
             if self._closed:
-                raise RuntimeError("scheduler closed")
+                raise SchedulerShutdown("scheduler closed")
             self._queue.append((req, auth, fut))
             self._last_enqueue = time.monotonic()
             if len(self._queue) == 1:
@@ -115,23 +134,53 @@ class BatchScheduler:
         """Collector loop wrapper: a crash in the loop must not strand
         blocked submitters (ADVICE r3: submit() waits on fut.result()
         with no timeout — a dead worker meant a hung client forever).
-        Fail every queued and in-flight future, then re-raise so the
-        death is loud in logs; subsequent submits raise immediately."""
-        try:
-            self._run_inner()
-        except BaseException as exc:
-            with self._cv:
-                self._closed = True
-                stranded = [fut for _, _, fut in self._queue]
-                self._queue.clear()
-                self._cv.notify_all()
-            stranded += self._inflight
-            for fut in stranded:
-                if not fut.done():
-                    fut.set_exception(
-                        RuntimeError(f"scheduler worker died: {exc!r}")
-                    )
-            raise
+        Fail every queued and in-flight future and count the crash;
+        with ``restart_on_crash`` the loop is revived in place (the
+        supervised-restart mode — the thread never reads as dead),
+        otherwise re-raise so the death is loud in logs and subsequent
+        submits fail immediately."""
+        while True:
+            try:
+                self._run_inner()
+                return
+            except BaseException as exc:
+                with self._cv:
+                    self._closed = True
+                    stranded = [fut for _, _, fut in self._queue]
+                    self._queue.clear()
+                    self._cv.notify_all()
+                stranded += self._inflight
+                for fut in stranded:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError(f"scheduler worker died: {exc!r}")
+                        )
+                crash_counter = getattr(
+                    self.metrics, "record_worker_crash", None
+                )
+                if crash_counter is not None:
+                    crash_counter()
+                self._crash_streak += 1
+                if (
+                    not self._restart_on_crash
+                    or self._shutdown
+                    or self._crash_streak > self.max_crash_streak
+                ):
+                    raise
+                import logging
+
+                logging.getLogger("grapevine_tpu.scheduler").exception(
+                    "collector crashed (streak %d/%d); supervised "
+                    "restart (--worker-restart)",
+                    self._crash_streak, self.max_crash_streak,
+                )
+                # jittered backoff so a hot fault loop cannot spin the
+                # core; capped well under the healthz stall threshold
+                time.sleep(min(5.0, 0.1 * (2 ** (self._crash_streak - 1))))
+                self._inflight = []
+                self._inflight_since = None
+                with self._cv:
+                    self._closed = self._shutdown
 
     def _run_inner(self):
         bs = self.engine.ecfg.batch_size
@@ -223,6 +272,7 @@ class BatchScheduler:
                         live = []
             if prev is not None:
                 self._settle(*prev)
+                self._crash_streak = 0  # a settled round = recovered
             if pending is None:
                 # nothing left on the device (prev, if any, just settled)
                 self._inflight_since = None
@@ -277,7 +327,23 @@ class BatchScheduler:
                     fut.set_exception(exc)
 
     def close(self):
+        """Graceful drain: stop admitting, settle queued-but-undispatched
+        ops with an explicit SchedulerShutdown (never silently dropped —
+        the serving layer maps it to gRPC UNAVAILABLE so clients retry
+        elsewhere), and let the worker finish the round already on the
+        device before joining."""
         with self._cv:
+            self._shutdown = True
             self._closed = True
+            undispatched = [fut for _, _, fut in self._queue]
+            self._queue.clear()
             self._cv.notify_all()
+        for fut in undispatched:
+            if not fut.done():
+                fut.set_exception(
+                    SchedulerShutdown(
+                        "scheduler draining: op was queued but not yet "
+                        "dispatched; retry against a serving replica"
+                    )
+                )
         self._worker.join(timeout=5)

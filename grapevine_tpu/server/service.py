@@ -34,7 +34,7 @@ from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire import protowire as pw
 from ..wire.records import QueryRequest
-from .scheduler import AuthFailure, BatchScheduler
+from .scheduler import AuthFailure, BatchScheduler, SchedulerShutdown
 
 log = logging.getLogger("grapevine_tpu.server")
 
@@ -86,15 +86,26 @@ class GrapevineServer:
         identity: chan.ServerIdentity | None = None,
         scheduler=None,
         leakmon=None,
+        durability=None,
+        worker_restart: bool = False,
     ):
         self.config = config or GrapevineConfig()
         if scheduler is not None:
             # injected op sink (server/tier.py's FrontendServer passes
             # its engine-tier RPC stub): no in-process device engine
+            if durability is not None:
+                raise ValueError(
+                    "durability needs the device engine in-process (the "
+                    "frontend role has no state to checkpoint)"
+                )
             self.engine = None
             self.scheduler = scheduler
         else:
-            self.engine = GrapevineEngine(self.config, seed=seed)
+            # constructing a durable engine runs recovery (checkpoint
+            # load + journal replay) before the listener ever binds
+            self.engine = GrapevineEngine(
+                self.config, seed=seed, durability=durability
+            )
             sched_kwargs = (
                 {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
             )
@@ -104,6 +115,7 @@ class GrapevineServer:
                 self.engine,
                 clock=clock,
                 scheme=get_signature_scheme(self.config.signature_scheme),
+                restart_on_crash=worker_restart,
                 **sched_kwargs,
             )
         self.attestation = attestation or chan.NullAttestation()
@@ -243,6 +255,11 @@ class GrapevineServer:
                 )
             except AuthFailure:
                 context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad challenge signature")
+            except SchedulerShutdown as exc:
+                # the drain path's explicit settle: the op never reached
+                # the device — UNAVAILABLE tells the client to retry
+                # against a serving replica
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
             ciphertext = session.channel.encrypt(resp.pack())
         return pw.encode_envelope(pw.EnvelopeMessage(data=ciphertext))
 
@@ -329,6 +346,10 @@ class GrapevineServer:
         if self.engine is not None:
             age = self.engine.metrics.last_round_age()
             detail["last_round_age_s"] = None if age is None else round(age, 3)
+            if self.engine.durability is not None:
+                # last-durable-round + recovery progress (batch-level
+                # sequence numbers only) — the RPO a probe can alert on
+                detail["durability"] = self.engine.durability.status()
         if self.leakmon is not None:
             # the leak audit verdict is part of liveness: a SUSPECT
             # transcript means the engine is *misbehaving* even though
@@ -364,7 +385,10 @@ class GrapevineServer:
         run_expiry_loop(self.engine, self.config, self._expiry_stop,
                         self.clock, health=self.health)
 
-    def stop(self, grace: float = 1.0):
+    def stop(self, grace: float = 1.0, checkpoint: bool = False):
+        """Drain: stop listeners, settle queued ops (SchedulerShutdown),
+        finish the in-flight round, then optionally seal a final
+        checkpoint — the SIGTERM path server/cli.py installs."""
         self._expiry_stop.set()
         if self._metrics_server is not None:
             self._metrics_server.stop()
@@ -374,6 +398,10 @@ class GrapevineServer:
         self.scheduler.close()
         if self.leakmon is not None:
             self.leakmon.close()
+        if self.engine is not None:
+            if checkpoint:
+                self.engine.checkpoint_now()
+            self.engine.close()
 
     def wait(self):
         if self._grpc_server is not None:

@@ -45,7 +45,7 @@ from ..engine.batcher import validate_request
 from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire.records import QueryRequest, QueryResponse
-from .scheduler import AuthFailure
+from .scheduler import AuthFailure, SchedulerShutdown
 
 log = logging.getLogger("grapevine_tpu.tier")
 
@@ -63,7 +63,8 @@ class EngineServer:
     """
 
     def __init__(self, config: GrapevineConfig | None = None, seed: int = 0,
-                 max_wait_ms: float | None = None, clock=None, leakmon=None):
+                 max_wait_ms: float | None = None, clock=None, leakmon=None,
+                 durability=None, worker_restart: bool = False):
         from ..engine.batcher import GrapevineEngine
         from ..session import get_signature_scheme
         from .scheduler import BatchScheduler
@@ -71,7 +72,10 @@ class EngineServer:
         import time as _time
 
         self.config = config or GrapevineConfig()
-        self.engine = GrapevineEngine(self.config, seed=seed)
+        # durable construction runs recovery before the listener binds
+        self.engine = GrapevineEngine(
+            self.config, seed=seed, durability=durability
+        )
         #: continuous obliviousness auditing (obs/leakmon.py) — the
         #: engine tier owns the device, so it owns the transcript audit
         self.leakmon = None
@@ -85,6 +89,7 @@ class EngineServer:
             self.engine,
             clock=clock,
             scheme=get_signature_scheme(self.config.signature_scheme),
+            restart_on_crash=worker_restart,
             **kwargs,
         )
         self._grpc_server: grpc.Server | None = None
@@ -118,6 +123,10 @@ class EngineServer:
         except AuthFailure:
             context.abort(grpc.StatusCode.UNAUTHENTICATED,
                           "bad challenge signature")
+        except SchedulerShutdown as exc:
+            # drain settle: UNAVAILABLE is what the frontend stub's
+            # bounded retry keys on (and never auth/protocol errors)
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
         return resp.pack()
 
     def start(self, address: str = "127.0.0.1:0") -> int:
@@ -169,6 +178,8 @@ class EngineServer:
             "stall_age_s": round(stall, 3),
             "last_round_age_s": None if age is None else round(age, 3),
         }
+        if self.engine.durability is not None:
+            detail["durability"] = self.engine.durability.status()
         if self.leakmon is not None:
             # same folding as the monolithic server: a SUSPECT transcript
             # is a serving fault — 503 stops routing (cached verdict; the
@@ -198,7 +209,9 @@ class EngineServer:
         )
         return self._metrics_server.start()
 
-    def stop(self, grace: float = 1.0):
+    def stop(self, grace: float = 1.0, checkpoint: bool = False):
+        """Drain the engine tier; with ``checkpoint`` seal the final
+        state after the scheduler settles (the SIGTERM path)."""
         self._expiry_stop.set()
         if self._metrics_server is not None:
             self._metrics_server.stop()
@@ -208,29 +221,79 @@ class EngineServer:
         self.scheduler.close()
         if self.leakmon is not None:
             self.leakmon.close()
+        if checkpoint:
+            self.engine.checkpoint_now()
+        self.engine.close()
 
 
 class _EngineStub:
     """Scheduler-shaped adapter over the engine tier's Submit RPC, so
-    the frontend can reuse GrapevineServer._query verbatim."""
+    the frontend can reuse GrapevineServer._query verbatim.
 
-    def __init__(self, address: str):
+    Every RPC carries a deadline (a wedged engine must fail the client's
+    call, not hang the frontend handler thread forever), and UNAVAILABLE
+    — the engine restarting, draining, or unreachable — is retried a
+    bounded number of times with jittered exponential backoff. Nothing
+    else is retried: UNAUTHENTICATED / INVALID_ARGUMENT are deliberate
+    rejections (retrying them re-spends a challenge), and
+    DEADLINE_EXCEEDED is ambiguous — the op may have committed, and
+    Submit is not idempotent."""
+
+    def __init__(self, address: str, deadline_s: float = 30.0,
+                 max_retries: int = 3, backoff_s: float = 0.05,
+                 backoff_cap_s: float = 2.0):
         self._grpc = grpc.insecure_channel(address)
         identity = lambda b: b  # noqa: E731
         self._submit = self._grpc.unary_unary(
             f"/{ENGINE_SERVICE_NAME}/Submit",
             request_serializer=identity, response_deserializer=identity,
         )
+        self.deadline_s = deadline_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self._c_retries = None
+
+    def bind_registry(self, registry) -> None:
+        """Register the retry counter on the frontend's telemetry
+        registry (counts only — batch-level by construction)."""
+        self._c_retries = registry.counter(
+            "grapevine_engine_rpc_retries_total",
+            "engine-tier Submit RPCs retried after UNAVAILABLE",
+        )
 
     def submit(self, req: QueryRequest, auth=None) -> QueryResponse:
+        import random
+        import time as _time
+
         challenge = auth[2] if auth else b"\x00" * C.CHALLENGE_SIZE
-        try:
-            data = self._submit(req.pack() + challenge)
-        except grpc.RpcError as e:
-            if e.code() == grpc.StatusCode.UNAUTHENTICATED:
-                raise AuthFailure(str(e.details())) from None
-            raise
-        return QueryResponse.unpack(data)
+        payload = req.pack() + challenge
+        attempt = 0
+        while True:
+            try:
+                data = self._submit(payload, timeout=self.deadline_s)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNAUTHENTICATED:
+                    raise AuthFailure(str(e.details())) from None
+                if (
+                    e.code() != grpc.StatusCode.UNAVAILABLE
+                    or attempt >= self.max_retries
+                ):
+                    raise
+                attempt += 1
+                if self._c_retries is not None:
+                    self._c_retries.inc()
+                delay = min(
+                    self.backoff_cap_s,
+                    self.backoff_s * (2 ** (attempt - 1)),
+                ) * random.uniform(0.5, 1.5)
+                log.warning(
+                    "engine Submit UNAVAILABLE (%s); retry %d/%d in %.0f ms",
+                    e.details(), attempt, self.max_retries, delay * 1e3,
+                )
+                _time.sleep(delay)
+                continue
+            return QueryResponse.unpack(data)
 
     def close(self):
         self._grpc.close()
@@ -255,6 +318,7 @@ class FrontendServer:
         # engine-tier RPC stub (GrapevineServer's injected-scheduler
         # mode): every session/auth behavior and its tests carry over
         # unchanged, and there is no device engine in this process.
+        stub = _EngineStub(engine_address)
         self._inner = GrapevineServer(
             config=config,
             attestation=attestation,
@@ -262,8 +326,9 @@ class FrontendServer:
             session_ttl=session_ttl,
             max_sessions=max_sessions,
             identity=identity,
-            scheduler=_EngineStub(engine_address),
+            scheduler=stub,
         )
+        stub.bind_registry(self._inner.metrics_registry)
 
     def start(self, listen_uri, tls_cert: bytes | None = None,
               tls_key: bytes | None = None) -> int:

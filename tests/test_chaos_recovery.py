@@ -1,0 +1,51 @@
+"""Kill-at-every-phase chaos loop (slow): SIGKILL the engine process at
+every instrumented fault site in the journal/checkpoint protocol — plus
+randomized wall-clock kills — restart, and assert recovery is
+bit-identical to an uninterrupted run with the leak monitor PASS
+throughout.
+
+Drives tools/chaos_run.py (the standalone ≥50-trial acceptance harness:
+``python tools/chaos_run.py --trials 50``) at a phase-exhaustive trial
+count that fits the slow bucket. Each trial spawns child processes, so
+this must never run inside tier-1's budget — hence ``-m slow``.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_chaos():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos_run
+
+    return chaos_run
+
+
+def test_kill_at_every_fault_point_recovers_bit_identical():
+    """One trial per crash site (testing/faults.py ALL_POINTS) plus one
+    timer-kill trial: recovered state and every recorded response hash
+    must match the uninterrupted oracle, and leakmon must report PASS on
+    the recovered engine."""
+    chaos = _load_chaos()
+    from grapevine_tpu.testing.faults import ALL_POINTS
+
+    args = chaos.parse_args(["--events", "18"])
+    failures = chaos.run_trials(0, args, modes=list(ALL_POINTS) + ["timer"])
+    assert not failures, "\n".join(failures)
+
+
+def test_randomized_kill_trials_recover_bit_identical():
+    """A handful of randomized trials (site and trigger count drawn per
+    trial) on top of the exhaustive pass — the shape the standalone
+    50-trial acceptance run uses."""
+    chaos = _load_chaos()
+
+    args = chaos.parse_args(["--events", "18", "--seed", "77"])
+    failures = chaos.run_trials(6, args)
+    assert not failures, "\n".join(failures)

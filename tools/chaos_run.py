@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Crash-recovery chaos harness (the PR-4 acceptance gate).
+
+Runs a deterministic engine workload in a child process with durability
+on, SIGKILLs the child at a randomized point — either an armed
+fault-injection site inside the journal/checkpoint protocol
+(testing/faults.py) or a random wall-clock timer — restarts it until the
+workload completes, and asserts the run is **bit-identical** to an
+uninterrupted oracle:
+
+- every per-round response hash the (possibly many) child incarnations
+  recorded matches the oracle's hash for that round;
+- the final recovered engine state equals the oracle's final state,
+  byte for byte (engine/checkpoint.py's canonical serialization);
+- the leak monitor verdict stays PASS on the recovered engine
+  (obliviousness survives recovery);
+- no run ever half-loads a torn checkpoint or journal file (a child
+  incarnation failing with anything but SIGKILL fails the trial).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --trials 50
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --points   # one trial
+                                                           # per fault site
+
+The child re-enters this file with ``--child``; a shared JAX persistent
+compilation cache keeps relaunches from re-paying the compile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NOW0 = 1_700_000_000
+ENGINE_SEED = 3
+SWEEP_PERIOD = 10_000
+MAX_RESTARTS = 60
+
+
+def _config():
+    from grapevine_tpu.config import GrapevineConfig
+
+    return GrapevineConfig(
+        max_messages=64, max_recipients=8, mailbox_cap=4,
+        batch_size=4, stash_size=64, bucket_cipher_rounds=0,
+    )
+
+
+def _key(n: int) -> bytes:
+    return bytes([n & 0xFF, (n >> 8) & 0xFF, n ^ 0x5A]) + b"\x01" * 29
+
+
+def build_schedule(seed: int, n_events: int):
+    """Deterministic event list; event i carries journal seq i+1.
+
+    Requests avoid response-derived inputs (zero-id READ/DELETE pops
+    instead of id lookups) so the schedule is a pure function of the
+    seed — any incarnation of the child reconstructs it identically."""
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    rng = random.Random(seed)
+    events = []
+    for i in range(n_events):
+        if i % 7 == 5:
+            events.append(("sweep", NOW0 + i, SWEEP_PERIOD))
+            continue
+        reqs = []
+        for _ in range(rng.randrange(1, 5)):
+            c = rng.random()
+            if c < 0.6:
+                rt, rcp = C.REQUEST_TYPE_CREATE, _key(rng.randrange(1, 6))
+            elif c < 0.9:
+                rt, rcp = C.REQUEST_TYPE_READ, C.ZERO_PUBKEY
+            else:
+                rt, rcp = C.REQUEST_TYPE_DELETE, C.ZERO_PUBKEY
+            reqs.append(QueryRequest(
+                request_type=rt,
+                auth_identity=_key(rng.randrange(1, 6)),
+                auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                record=RequestRecord(
+                    msg_id=C.ZERO_MSG_ID,
+                    recipient=rcp,
+                    payload=bytes([rng.randrange(256)]) * C.PAYLOAD_SIZE,
+                ),
+            ))
+        events.append(("round", NOW0 + i, reqs))
+    return events
+
+
+def _resp_hash(resps) -> str:
+    return hashlib.sha256(b"".join(r.pack() for r in resps)).hexdigest()
+
+
+def _run_events(engine, events, start: int, progress=None):
+    """Drive ``events[start:]``; append ``seq hash`` progress lines."""
+    for i in range(start, len(events)):
+        ev = events[i]
+        if ev[0] == "round":
+            h = _resp_hash(engine.handle_queries(ev[2], ev[1]))
+        else:
+            engine.expire(ev[1], period=ev[2])
+            h = "sweep"
+        if progress is not None:
+            progress.write(f"{i + 1} {h}\n")
+            progress.flush()
+
+
+def run_child(args) -> int:
+    from grapevine_tpu.config import DurabilityConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.engine.checkpoint import state_to_bytes
+    from grapevine_tpu.obs.leakmon import EngineLeakMonitor, LeakMonitorConfig
+
+    dcfg = DurabilityConfig(
+        state_dir=args.state_dir,
+        checkpoint_every_rounds=args.checkpoint_every,
+        journal_fsync_every=1,
+    )
+    engine = GrapevineEngine(_config(), seed=ENGINE_SEED, durability=dcfg)
+    monitor = EngineLeakMonitor.for_engine(
+        engine, LeakMonitorConfig(window_rounds=64)
+    )
+    engine.attach_leakmon(monitor)
+    events = build_schedule(args.schedule_seed, args.events)
+    start = engine.durability.seq  # events[:start] are already durable
+    with open(args.progress, "a") as pf:
+        _run_events(engine, events, start, pf)
+        monitor.close()  # drain the detector queue before the verdict
+        verdict = monitor.verdict()["verdict"]
+        final = hashlib.sha256(
+            state_to_bytes(engine.ecfg, engine.state)
+        ).hexdigest()
+        pf.write(f"leakmon {verdict}\n")
+        pf.write(f"final {final}\n")
+        pf.flush()
+    engine.close()
+    return 0
+
+
+def oracle(schedule_seed: int, n_events: int):
+    """Uninterrupted in-process run: per-seq hashes + final state hash."""
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.engine.checkpoint import state_to_bytes
+
+    engine = GrapevineEngine(_config(), seed=ENGINE_SEED)
+    events = build_schedule(schedule_seed, n_events)
+    hashes: dict[int, str] = {}
+    for i, ev in enumerate(events):
+        if ev[0] == "round":
+            hashes[i + 1] = _resp_hash(engine.handle_queries(ev[2], ev[1]))
+        else:
+            engine.expire(ev[1], period=ev[2])
+            hashes[i + 1] = "sweep"
+    final = hashlib.sha256(
+        state_to_bytes(engine.ecfg, engine.state)
+    ).hexdigest()
+    return hashes, final
+
+
+def _parse_progress(path: str):
+    seq_hashes: dict[int, str] = {}
+    finals, leakmons = [], []
+    try:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+    except FileNotFoundError:
+        return seq_hashes, finals, leakmons
+    for line in lines:
+        parts = line.split()
+        if len(parts) != 2:
+            continue  # torn progress line from a mid-write kill
+        tag, val = parts
+        if tag == "final":
+            finals.append(val)
+        elif tag == "leakmon":
+            leakmons.append(val)
+        elif tag.isdigit():
+            seq_hashes[int(tag)] = val
+    return seq_hashes, finals, leakmons
+
+
+def run_trial(trial: int, mode: str, rng: random.Random, args,
+              oracle_hashes, oracle_final, cache_dir: str) -> list[str]:
+    """One kill-recover-verify trial; returns a list of failure strings."""
+    errors: list[str] = []
+    with tempfile.TemporaryDirectory(prefix=f"chaos{trial}-") as state_dir:
+        progress = os.path.join(state_dir, "progress.log")
+        child_cmd = [
+            sys.executable, os.path.abspath(__file__), "--child",
+            "--state-dir", state_dir, "--progress", progress,
+            "--events", str(args.events),
+            "--schedule-seed", str(args.schedule_seed),
+            "--checkpoint-every", str(args.checkpoint_every),
+        ]
+        base_env = dict(
+            os.environ,
+            JAX_COMPILATION_CACHE_DIR=cache_dir,
+            JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        )
+        base_env.pop("GRAPEVINE_FAULTS", None)
+        kills = 0
+        launch = 0
+        while True:
+            env = dict(base_env)
+            timer_kill = None
+            if launch == 0:
+                if mode == "timer":
+                    timer_kill = rng.uniform(1.0, args.timer_max_s)
+                else:
+                    # checkpoint sites fire once per --checkpoint-every
+                    # records, append sites once per record — scale the
+                    # trigger count so the fault actually lands mid-run
+                    cap = (
+                        max(2, args.events // args.checkpoint_every)
+                        if mode.startswith("checkpoint.")
+                        else max(2, args.events // 2)
+                    )
+                    env["GRAPEVINE_FAULTS"] = f"{mode}={rng.randrange(1, cap)}"
+            proc = subprocess.Popen(
+                child_cmd, env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            )
+            if timer_kill is not None:
+                try:
+                    proc.wait(timeout=timer_kill)
+                except subprocess.TimeoutExpired:
+                    proc.send_signal(signal.SIGKILL)
+            _, err = proc.communicate()
+            rc = proc.returncode
+            if rc == 0:
+                break
+            if rc != -signal.SIGKILL:
+                errors.append(
+                    f"trial {trial} [{mode}]: child exited rc={rc} "
+                    f"(want clean or SIGKILL): {err.decode()[-2000:]}"
+                )
+                return errors
+            kills += 1
+            launch += 1
+            if launch > MAX_RESTARTS:
+                errors.append(
+                    f"trial {trial} [{mode}]: no clean run after "
+                    f"{MAX_RESTARTS} restarts"
+                )
+                return errors
+        seq_hashes, finals, leakmons = _parse_progress(progress)
+        for seq, h in sorted(seq_hashes.items()):
+            if oracle_hashes.get(seq) != h:
+                errors.append(
+                    f"trial {trial} [{mode}]: responses for round {seq} "
+                    f"diverge from the uninterrupted run"
+                )
+        if not finals or finals[-1] != oracle_final:
+            errors.append(
+                f"trial {trial} [{mode}]: final recovered state is not "
+                f"bit-identical to the uninterrupted run"
+            )
+        if not leakmons or leakmons[-1] != "PASS":
+            errors.append(
+                f"trial {trial} [{mode}]: leak monitor verdict "
+                f"{leakmons[-1] if leakmons else 'missing'} (want PASS)"
+            )
+        if not errors:
+            print(
+                f"trial {trial:3d} [{mode:>26s}]: PASS "
+                f"({kills} kill{'s' if kills != 1 else ''}, "
+                f"{len(seq_hashes)}/{len(oracle_hashes)} rounds recorded)",
+                flush=True,
+            )
+    return errors
+
+
+def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
+    """Run ``n_trials`` randomized trials (or one per entry of
+    ``modes``); returns accumulated failures. Importable by the slow
+    chaos test (tests/test_chaos_recovery.py)."""
+    from grapevine_tpu.testing.faults import ALL_POINTS
+
+    args = args or parse_args([])
+    rng = random.Random(args.seed)
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), "grapevine_chaos_jax_cache"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    t0 = time.monotonic()
+    oracle_hashes, oracle_final = oracle(args.schedule_seed, args.events)
+    print(f"oracle: {len(oracle_hashes)} events in "
+          f"{time.monotonic() - t0:.1f}s", flush=True)
+    if modes is None:
+        modes = [
+            rng.choice(list(ALL_POINTS) + ["timer"]) for _ in range(n_trials)
+        ]
+    failures: list[str] = []
+    for trial, mode in enumerate(modes):
+        failures.extend(
+            run_trial(trial, mode, rng, args, oracle_hashes, oracle_final,
+                      cache_dir)
+        )
+    return failures
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--state-dir")
+    p.add_argument("--progress")
+    p.add_argument("--trials", type=int, default=50)
+    p.add_argument("--points", action="store_true",
+                   help="one trial per fault-injection site instead of "
+                   "randomized trials")
+    p.add_argument("--events", type=int, default=24)
+    p.add_argument("--schedule-seed", type=int, default=11)
+    p.add_argument("--checkpoint-every", type=int, default=5)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--timer-max-s", type=float, default=12.0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    if args.child:
+        return run_child(args)
+    from grapevine_tpu.testing.faults import ALL_POINTS
+
+    modes = list(ALL_POINTS) + ["timer"] if args.points else None
+    failures = run_trials(args.trials, args, modes=modes)
+    for f in failures:
+        print(f"CHAOS FAILURE: {f}", file=sys.stderr)
+    n = len(modes) if modes else args.trials
+    print(f"chaos: {n} trials, {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
