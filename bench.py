@@ -13,6 +13,9 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
   4b. vphases_ab         dense vs scan slot-order machinery A/B —
                          B-sweep (64/256/1024) of per-op round cost,
                          interleaved (PR3; PERF.md Round 6)
+  4c. sort_ab            xla vs radix bounded-key sort engine A/B —
+                         eviction/dedup machinery + whole-round
+                         B-sweep, interleaved (PR5; PERF.md Round 7)
   5. sharded             bucket-tree sharded over a device mesh (CPU
                          mesh subprocess when one chip is visible)
   6. server_loopback     full-stack gRPC: session crypto + batched
@@ -43,7 +46,8 @@ def _p99(times_s: list[float]) -> float:
 
 
 def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp",
-               vphases_impl=None, cipher_rounds=8, mailbox_cap=None):
+               vphases_impl=None, cipher_rounds=8, mailbox_cap=None,
+               sort_impl=None):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
@@ -60,6 +64,7 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="j
         bucket_cipher_impl=cipher_impl,
         bucket_cipher_rounds=cipher_rounds,
         vphases_impl=vphases_impl,
+        sort_impl=sort_impl,
         **extra,
     )
     ecfg = EngineConfig.from_config(cfg)
@@ -487,6 +492,152 @@ def _vphases_machinery_sweep(smoke):
     return res
 
 
+def bench_sort_ab(smoke):
+    """Config 4c: xla vs radix bounded-key sort engine A/B (PR5).
+
+    Two scopes, both interleaved min-of-N (the min is the unbiased cost
+    of a shape-static oblivious program under this sandbox's 2-vCPU
+    scheduler noise — the vphases_ab methodology):
+
+    - **machinery**: the exact sort the knob swaps, isolated — stable
+      leaf-rank (``radix_rank`` vs ``jnp.argsort(stable=True)``) at
+      eviction-shaped working-set sizes W with h-bit keys, plus the
+      dedup group sort (``radix_group_sort`` vs
+      ``multiword_group_sort``) at round batch sizes. Radix is timed at
+      its best ``bits_per_pass`` per size so the comparison can't be
+      rigged against it.
+    - **whole round**: B-sweep with ``sort_impl`` as the only knob
+      (vphases pinned "scan" so the bounded group sorts are actually in
+      the round under both impls).
+
+    Honest-reporting note (the PR-3 lesson, PERF.md Round 7): on
+    XLA:CPU each radix pass pays a serial ~80 ns/elem scatter, so the
+    native comparison sort wins here at every size — these numbers are
+    the *CPU floor record* that justifies keeping ``sort_impl`` auto =
+    "xla" off-TPU; the TPU decision belongs to the capture's
+    ``sort_perf`` stage. Override sweeps with
+    GRAPEVINE_SORT_AB_BS / GRAPEVINE_SORT_AB_WS."""
+    import os
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from grapevine_tpu.oblivious.radix import radix_group_sort, radix_rank
+    from grapevine_tpu.oblivious.segmented import multiword_group_sort
+
+    def _min_of(fn, args, reps):
+        jax.block_until_ready(fn(*args))  # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(_time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    reps = 3 if smoke else 7
+    out = {"machinery": {}, "sweep": {}}
+
+    # --- machinery: eviction leaf rank at working-set sizes ------------
+    h = 16 if smoke else 20  # leaf bits of a 2^16 / 2^20-capacity tree
+    ws = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_SORT_AB_WS",
+            "4096,16384" if smoke else "16384,65536,262144",
+        ).split(",")
+    ]
+    rng = np.random.default_rng(5)
+    for w in ws:
+        keys = jnp.asarray(
+            rng.integers(0, 1 << h, w).astype(np.uint32)
+        )
+        tx = _min_of(
+            jax.jit(lambda k: jnp.argsort(k, stable=True)), (keys,), reps
+        )
+        # radix at its best pass width for this size (1-bit passes have
+        # no [W,R] bin table; wider passes amortize the per-pass
+        # gather+scatter) — report the winner so the A/B is fair to it
+        tr, bpp_best = None, None
+        for bpp in (1, 4, 8):
+            t = _min_of(
+                jax.jit(lambda k, b=bpp: radix_rank(k, h + 1, b)),
+                (keys,), reps,
+            )
+            if tr is None or t < tr:
+                tr, bpp_best = t, bpp
+        out["machinery"][f"evict_rank_w{w}"] = {
+            "key_bits": h + 1,
+            "xla_ms": round(tx * 1e3, 3),
+            "radix_ms": round(tr * 1e3, 3),
+            "radix_bits_per_pass": bpp_best,
+            "speedup_radix_over_xla": round(tx / tr, 3),
+        }
+    # --- machinery: dedup group sort at batch sizes --------------------
+    for b in (256, 1024) if smoke else (1024, 4096):
+        kb = max(1, (b * 4).bit_length())
+        idxs = jnp.asarray(
+            rng.integers(0, b * 4, b).astype(np.uint32)
+        )
+        tx = _min_of(jax.jit(lambda i: multiword_group_sort([i])), (idxs,), reps)
+        tr = _min_of(
+            jax.jit(lambda i: radix_group_sort([i], kb)), (idxs,), reps
+        )
+        out["machinery"][f"dedup_group_b{b}"] = {
+            "key_bits": kb,
+            "xla_ms": round(tx * 1e3, 3),
+            "radix_ms": round(tr * 1e3, 3),
+            "speedup_radix_over_xla": round(tx / tr, 3),
+        }
+
+    # --- whole round: sort_impl the only knob --------------------------
+    sweep = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_SORT_AB_BS", "64,256" if smoke else "64,256,1024"
+        ).split(",")
+    ]
+    n_timed = 3 if smoke else 9
+    for B in sweep:
+        ctxs = {}
+        for impl in ("xla", "radix"):
+            cfg, ecfg, state, step = _mk_engine(
+                1 << 12, 1 << 9, B, vphases_impl="scan", sort_impl=impl,
+                cipher_rounds=0, mailbox_cap=8,
+            )
+            batches = make_batches(3, B, seed=13)
+            state, resp, _ = step(ecfg, state, batches[0])
+            jax.block_until_ready(resp)
+            ctxs[impl] = [ecfg, state, step, batches]
+
+        def one_round(ctx, i):
+            ecfg, state, step, batches = ctx
+            t0 = _time.perf_counter()
+            state, resp, _ = step(ecfg, state, batches[i % 3])
+            jax.block_until_ready(resp)
+            ctx[1] = state
+            return _time.perf_counter() - t0
+
+        times = {"xla": [], "radix": []}
+        for i in range(n_timed):  # interleaved A/B
+            times["xla"].append(one_round(ctxs["xla"], i))
+            times["radix"].append(one_round(ctxs["radix"], i))
+        mx = float(np.min(times["xla"]))
+        mr = float(np.min(times["radix"]))
+        out["sweep"][str(B)] = {
+            "xla_round_ms": round(mx * 1e3, 2),
+            "radix_round_ms": round(mr * 1e3, 2),
+            "median_xla_round_ms": round(
+                float(np.median(times["xla"])) * 1e3, 2
+            ),
+            "median_radix_round_ms": round(
+                float(np.median(times["radix"])) * 1e3, 2
+            ),
+            "speedup_radix_over_xla": round(mx / mr, 3),
+        }
+    return out
+
+
 def bench_expiry_sweep(smoke):
     """Config 4: full-bus timestamped eviction scan (reference
     README.md:86-98) at the largest capacity that fits one chip:
@@ -789,6 +940,7 @@ CONFIGS = [
      lambda smoke: bench_zipf_pallas(smoke, "pallas_fused_tiled")),
     ("crd_loop", bench_crd_loop),
     ("vphases_ab", bench_vphases_ab),
+    ("sort_ab", bench_sort_ab),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),
@@ -900,6 +1052,27 @@ def _append_trajectory(line: dict, tag: str) -> None:
         print(f"[bench] trajectory append failed: {e}", file=sys.stderr)
 
 
+def _only_filter() -> list | None:
+    """``--only a,b`` (or ``--only=a,b``): run just those configs — for
+    banking one config's line (e.g. a PR's A/B) without paying the full
+    suite on a weak builder core. Unknown names fail fast."""
+    argv = sys.argv[1:]
+    val = None
+    for i, tok in enumerate(argv):
+        if tok == "--only" and i + 1 < len(argv):
+            val = argv[i + 1]
+        elif tok.startswith("--only="):
+            val = tok[len("--only="):]
+    if val is None:
+        return None
+    names = [n.strip() for n in val.split(",") if n.strip()]
+    known = {n for n, _ in CONFIGS}
+    bad = [n for n in names if n not in known]
+    if bad:
+        raise SystemExit(f"--only: unknown config(s) {bad}; known: {sorted(known)}")
+    return names
+
+
 def main():
     import os
 
@@ -959,7 +1132,12 @@ def main():
                 # leash the right trade (explicit env still wins)
                 per_cfg_s = 900.0
     _emit(results, meta)
-    for name, fn in CONFIGS:
+    only = _only_filter()
+    configs = (
+        CONFIGS if only is None
+        else [(n, f) for n, f in CONFIGS if n in only]
+    )
+    for name, fn in configs:
         elapsed = time.perf_counter() - t_start
         if elapsed > budget_s:
             results[name] = {"skipped":

@@ -119,6 +119,11 @@ class GrapevineConfig:
                 f"vphases_impl must be None, 'dense' or 'scan', got "
                 f"{self.vphases_impl!r}"
             )
+        if self.sort_impl not in (None, "xla", "radix"):
+            raise ValueError(
+                f"sort_impl must be None, 'xla' or 'radix', got "
+                f"{self.sort_impl!r}"
+            )
         if self.max_messages < 2 or self.max_messages & (self.max_messages - 1):
             raise ValueError("max_messages must be a power of two >= 2")
         if self.tree_density not in (1, 2, 4):
@@ -150,6 +155,28 @@ class GrapevineConfig:
     #: gather/scatter-bound; measured curve + the B=4096 dense memory
     #: math: PERF.md Round 6).
     vphases_impl: str | None = None
+
+    #: bounded-key sort engine for the device round (oblivious/radix.py):
+    #: "xla" = the comparison sorts XLA lowers natively (a serial
+    #: ``while`` thunk on XLA:CPU — the round's measured floor after
+    #: PR 3, PERF.md Round 6 — and a bitonic network on TPU), "radix" =
+    #: data-oblivious LSD counting passes for every sort whose key
+    #: carries a declared bit bound: eviction's leaf sort and round
+    #: dedup (oram/round.py), the scan impl's bucket/record group sorts
+    #: and the admission walk's slot grouping (engine/vphases.py). The
+    #: 256-bit recipient-key sort stays on lax.sort under either
+    #: setting (explicit key-bits gate: radix refuses keys wider than
+    #: MAX_RADIX_BITS rather than hashing them down). Bit-identical
+    #: responses and final engine state (tests/test_radix.py /
+    #: test_sort_radix.py; the radix ORAM round traces ZERO ``sort``
+    #: HLO ops, CI-audited). None = auto: currently "xla" on every
+    #: backend — on XLA:CPU the native serial sort beats any
+    #: scatter-per-pass radix formulation (each pass costs one ~80
+    #: ns/elem serial scatter; measured, bench.py ``sort_ab`` / PERF.md
+    #: Round 7), and on TPU — where scatters vectorize and lax.sort is
+    #: the O(n log² n) bitonic side — the default flips only on the
+    #: capture's ``sort_perf`` device A/B (the vphases_impl playbook).
+    sort_impl: str | None = None
 
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient

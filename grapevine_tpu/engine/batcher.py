@@ -18,6 +18,7 @@ import threading
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import DurabilityConfig, GrapevineConfig
@@ -240,6 +241,105 @@ class GrapevineEngine:
         """Attach an EngineLeakMonitor; subsequent rounds hand their
         transcripts to it off the jit path (PendingRound.resolve)."""
         self.leakmon = monitor
+
+    def calibrate_sort_phase(self, reps: int = 5) -> float:
+        """Measure the round's bounded-key sort workload standalone and
+        record it under the ``sort`` phase (obs/phases.py).
+
+        The host cannot time inside the fused round program, but every
+        sort the round runs is shape-static and data-independent
+        (oblivious), so a standalone jitted run of the same sort
+        machinery at the same geometry IS the per-round sort cost. The
+        workload reproduces each sort site at its round shape under the
+        engine's configured ``sort_impl``/``vphases_impl``: the three
+        eviction leaf-rank sorts at their working-set sizes, the
+        admission walk's slot grouping (both vphases impls), and —
+        scan impl — the three dedup group sorts, the per-phase
+        bucket/record index group sorts, and the wide-key recipient
+        grouping sort (always ``lax.sort``, counted because the round
+        pays it). Called once at serving startup (CLI engine/mono
+        roles) — one small jit compile, zero hot-path cost. Returns
+        the min-of-``reps`` seconds (the unbiased estimator for a
+        shape-static program under scheduler noise).
+        """
+        ecfg = self.ecfg
+        b, d = ecfg.batch_size, ecfg.mb_choices
+        jobs = []  # one per ORAM round: A (mailbox), B (records), C (mailbox)
+        for cfg, nb in ((ecfg.mb, b * d), (ecfg.rec, b), (ecfg.mb, b * d)):
+            w = cfg.stash_size + nb * cfg.path_len * cfg.bucket_slots + nb
+            jobs.append(
+                (w, cfg.height, max(1, cfg.dummy_index.bit_length()), nb)
+            )
+        simpl, vimpl = ecfg.sort_impl, ecfg.vphases_impl
+        slot_bits = max(1, (b - 1).bit_length())
+        # per-phase index group bounds (vphases._index_groups): bucket
+        # groups in rounds A/C, record-block groups in round B
+        g_bits = (
+            max(1, (ecfg.mb_table_buckets + 1 + b - 1).bit_length()),
+            max(1, (ecfg.rec.blocks + 1 + b - 1).bit_length()),
+            max(1, (ecfg.mb_table_buckets + 1 + b - 1).bit_length()),
+        )
+
+        def workload(key):
+            from ..oblivious.radix import radix_group_sort, radix_rank
+            from ..oblivious.segmented import (
+                group_sort,
+                multiword_group_sort,
+            )
+
+            u32 = jnp.uint32
+            outs = []
+            ks = jax.random.split(key, 3 * len(jobs) + 2)
+            for i, (w, h, kb, nb) in enumerate(jobs):
+                leaf = jax.random.bits(ks[3 * i], (w,), u32) & u32(
+                    (1 << h) - 1
+                )
+                if simpl == "radix":
+                    outs.append(radix_rank(leaf, h + 1))
+                else:
+                    outs.append(jnp.argsort(leaf))
+                if vimpl == "scan":
+                    idxs = jax.random.bits(ks[3 * i + 1], (nb,), u32) & u32(
+                        (1 << kb) - 1
+                    )
+                    gs = (
+                        radix_group_sort([idxs], kb)
+                        if simpl == "radix"
+                        else multiword_group_sort([idxs])
+                    )
+                    outs.extend(gs)
+                    gi = jax.random.bits(ks[3 * i + 2], (b,), u32) & u32(
+                        (1 << g_bits[i]) - 1
+                    )
+                    outs.extend(
+                        group_sort(gi, sort_impl=simpl, key_bits=g_bits[i])
+                    )
+            # admission slot grouping (runs under BOTH vphases impls)
+            rslot = jax.random.bits(ks[-2], (b,), u32) & u32(
+                (1 << slot_bits) - 1
+            )
+            outs.extend(
+                group_sort(rslot, sort_impl=simpl, key_bits=slot_bits)
+            )
+            if vimpl == "scan":
+                # recipient grouping: 10-word wide key, always lax.sort
+                kcols = [
+                    jax.random.bits(ks[-1], (b,), u32) for _ in range(10)
+                ]
+                outs.extend(multiword_group_sort(kcols))
+            return outs
+
+        fn = jax.jit(workload)
+        key = jax.random.PRNGKey(0)
+        jax.block_until_ready(fn(key))  # compile + warm
+        best = None
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(key))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        self.metrics.observe_phase("sort", best)
+        return best
 
     def handle_queries(
         self, reqs: list[QueryRequest], now: int

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..oblivious.bucket_cipher import epoch_next, row_keystream
 from ..oblivious.primitives import SENTINEL, is_zero_words, u64_le, u64_sub
+from ..oblivious.radix import partition_rank
 from ..obs.phases import device_phase
 from ..oram.path_oram import OramConfig, OramState
 from .state import (
@@ -200,14 +201,14 @@ def expiry_sweep(
     mb = mb._replace(stash_idx=mb_stash_idx, stash_val=mb_stash_val)
 
     # --- rebuild the free-block list from surviving record liveness ----
-    # stable partition (free indices first, each side in index order) via
-    # two exclusive ranks + one unique scatter — O(n) instead of the
-    # O(n log n) full argsort, identical output by construction
-    pi = present.astype(jnp.int32)
-    n_free = jnp.sum(1 - pi)
-    rank_free = jnp.cumsum(1 - pi) - (1 - pi)  # exclusive rank among free
-    rank_used = jnp.cumsum(pi) - pi  # exclusive rank among used
-    pos = jnp.where(present, n_free + rank_used, rank_free).astype(U32)
+    # stable partition (free indices first, each side in index order):
+    # the 1-bit counting pass of the radix-rank engine — two exclusive
+    # ranks + one unique scatter, O(n), sort-free under every sort_impl
+    # (this site's O(n log n) argsort was retired in Round 5; the shared
+    # primitive keeps the idiom in one place). Identical output by
+    # construction: pos is exactly where a stable free-first partition
+    # puts each index.
+    pos = partition_rank(present).astype(U32)
     freelist = (
         jnp.zeros((n_msgs,), U32)
         .at[pos]

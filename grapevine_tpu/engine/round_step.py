@@ -215,7 +215,7 @@ def engine_round_step(
         mb1, out_a, leaf_a = oram_round(
             ecfg.mb, state.mb, idxs_mb_flat, nl_a, dl_a,
             phase_a_batch(ecfg, ctx), axis_name,
-            occ_impl=ecfg.vphases_impl,
+            occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
         )
     free_top = state.free_top - out_a["n_allocs"]
     recipients = state.recipients + out_a["n_claims"]
@@ -250,7 +250,7 @@ def engine_round_step(
         rec1, out_b, leaf_b = oram_round(
             ecfg.rec, state.rec, idx_b, nl_b, dl_b,
             phase_b_batch(ecfg, ctx_b), axis_name,
-            occ_impl=ecfg.vphases_impl,
+            occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
         )
 
     # freed blocks return to the freelist in slot order — one vectorized
@@ -273,7 +273,7 @@ def engine_round_step(
         mb2, _out_c, leaf_c = oram_round(
             ecfg.mb, mb1, idxs_mb_flat, nl_c, dl_c,
             phase_c_batch(ecfg, ctx_c), axis_name,
-            occ_impl=ecfg.vphases_impl,
+            occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
         )
 
     # ---- response assembly (shared with the op-major engine) ----------

@@ -78,6 +78,9 @@ class EngineConfig:
     #: slot-order machinery (engine/vphases.py): "dense" [B,B] masks or
     #: "scan" sort + segmented scans — bit-identical semantics
     vphases_impl: str = "dense"
+    #: bounded-key sort engine (oblivious/radix.py): "xla" comparison
+    #: sorts or "radix" counting passes — bit-identical permutations
+    sort_impl: str = "xla"
 
     @property
     def id_bits(self) -> int:
@@ -90,17 +93,30 @@ class EngineConfig:
         k = max(1, cfg.mailbox_slots)
         mb_value_words = k * (KEY_WORDS + ENTRY_WORDS * cfg.mailbox_cap)
         vimpl = cfg.vphases_impl
-        if vimpl is None:
-            # per-backend default: the MXU eats the [B,B] masks, scalar
-            # backends pay O(B²) directly (config.py knob docstring).
-            # Resolved here — engine construction time — because config
-            # objects must stay importable without initializing a JAX
-            # backend.
+        simpl = cfg.sort_impl
+        if vimpl is None or simpl is None:
+            # per-backend defaults: the MXU eats the [B,B] masks and
+            # lowers lax.sort to a parallel bitonic network; scalar
+            # backends pay O(B²) masks and *serial* comparison sorts
+            # directly (config.py knob docstrings). Resolved here —
+            # engine construction time — because config objects must
+            # stay importable without initializing a JAX backend.
             from ..config import TPU_BACKENDS
 
-            vimpl = (
-                "dense" if jax.default_backend() in TPU_BACKENDS else "scan"
-            )
+            on_tpu = jax.default_backend() in TPU_BACKENDS
+            if vimpl is None:
+                vimpl = "dense" if on_tpu else "scan"
+            if simpl is None:
+                # "xla" on EVERY backend until measured otherwise: on
+                # XLA:CPU the native serial sort (~0.4 µs/elem) beats
+                # any scatter-per-pass radix formulation (~80 ns/elem
+                # PER scatter, one per pass — bench.py `sort_ab`,
+                # PERF.md Round 7); on TPU — where scatters vectorize
+                # and the bitonic lax.sort is the O(n log² n) side —
+                # the decision belongs to tools/tpu_capture.py's
+                # `sort_perf` A/B on a real chip (the vphases_impl
+                # playbook).
+                simpl = "xla"
         return cls(
             max_messages=cfg.max_messages,
             max_recipients=cfg.max_recipients,
@@ -129,6 +145,7 @@ class EngineConfig:
             mb_slots=k,
             mb_choices=cfg.resolved_mailbox_choices,
             vphases_impl=vimpl,
+            sort_impl=simpl,
         )
 
 

@@ -241,10 +241,23 @@ class _SortedGroups:
     One O(B log B) variadic sort orders ops by (group key, slot); every
     aggregation is then a cumsum / segmented scan over the sorted order
     plus a permutation inverse — no [B,B] intermediate anywhere.
+
+    ``sort_impl="radix"`` with a declared per-column ``key_bits`` bound
+    swaps the comparison sort for bounded-key counting passes
+    (oblivious/radix.py) — bit-identical (perm, inv, seg). Callers that
+    cannot bound their key (the 256-bit recipient pubkey) pass
+    ``key_bits=None`` and keep ``lax.sort``; radix itself refuses keys
+    wider than ``MAX_RADIX_BITS`` so correctness can never silently
+    ride on a hashed-down key.
     """
 
-    def __init__(self, cols):
-        self.perm, self.inv, self.seg = multiword_group_sort(cols)
+    def __init__(self, cols, key_bits=None, sort_impl: str = "xla"):
+        if sort_impl == "radix" and key_bits is not None:
+            from ..oblivious.radix import radix_group_sort
+
+            self.perm, self.inv, self.seg = radix_group_sort(cols, key_bits)
+        else:
+            self.perm, self.inv, self.seg = multiword_group_sort(cols)
         b = self.perm.shape[0]
         self.b = b
         self.start, self.end = segment_bounds(self.seg)
@@ -333,7 +346,10 @@ def _recipient_groups(ecfg: EngineConfig, ka: jax.Array, is_real: jax.Array):
         return _DenseGroups(requal)
     iota = jnp.arange(b, dtype=U32)
     # key = (real?, ka words, dummy-uniquifier): real ops group by ka,
-    # each dummy is its own group
+    # each dummy is its own group. 1 + 8·32 + 32 declared bits — far
+    # past MAX_RADIX_BITS, so this sort stays on lax.sort under every
+    # sort_impl (radix would demand a hashed-down key, and grouping
+    # correctness must never depend on a hash).
     cols = (
         [(~is_real).astype(U32)]
         + [ka[:, w] for w in range(KEY_WORDS)]
@@ -358,7 +374,12 @@ def _index_groups(ecfg: EngineConfig, idx: jax.Array, is_real: jax.Array,
         )
         return _DenseGroups(eq)
     iota = jnp.arange(b, dtype=U32)
-    return _SortedGroups([jnp.where(is_real, idx, U32(dummy_base) + iota)])
+    # bounded key: real < dummy_base, dummies dummy_base..dummy_base+B-1
+    return _SortedGroups(
+        [jnp.where(is_real, idx, U32(dummy_base) + iota)],
+        key_bits=max(1, (dummy_base + b - 1).bit_length()),
+        sort_impl=ecfg.sort_impl,
+    )
 
 
 def _mb_parse_batch(ecfg: EngineConfig, vals: jax.Array):
@@ -412,7 +433,12 @@ def _admission_fast(
     add = jnp.where(create_elem, 1, jnp.where(pop_elem, -1, 0)).astype(I32)
     lo = jnp.zeros((b,), I32)
     hi = jnp.full((b,), cap, I32)
-    perm, inv, seg = group_sort(rslot)
+    # rslot is a slot index (< B) — bounded, so the walk's grouping sort
+    # follows the sort_impl knob under BOTH vphases impls
+    perm, inv, seg = group_sort(
+        rslot, sort_impl=ecfg.sort_impl,
+        key_bits=max(1, (b - 1).bit_length()),
+    )
     pre = segmented_exclusive_sat_scan((add[perm], lo[perm], hi[perm]), seg)
     count_before = sat_apply(pre, init_count[perm])[inv]
 

@@ -1,0 +1,204 @@
+"""Data-oblivious LSD radix-rank over bounded keys — no comparison sort.
+
+Every hot sort in the engine orders a *bounded* key: eviction sorts the
+working set by leaf (``height+1`` bits), round dedup sorts by block
+index (``log2(blocks)+1`` bits), the scan vphases impl groups ops by
+bucket/record index, the admission walk groups by first-occurrence slot
+(``log2(B)`` bits). XLA lowers ``lax.sort``/``jnp.argsort`` for those
+to a generic comparison sort — a serial ``while`` thunk on XLA:CPU
+(measured as the round's floor after PR 3; PERF.md Round 6) and a
+bitonic network on TPU. A least-significant-digit radix *rank* does
+the same job in a fixed number of counting passes: per pass one
+conflict-free scatter-bincount, one cumsum, two gathers — all
+fully-vectorized, shape-static, data-independent. This is the standard
+move in hardware-oblivious-memory designs (Palermo, arXiv:2411.05400;
+BOLT, arXiv:2509.01742): replace comparison networks with fixed-shape
+counting passes that parallelize on wide SIMD/MXU hardware.
+
+Obliviousness: pass count, shapes, and the instruction trace depend
+only on the static ``(key_bits, bits_per_pass, B)`` — never on key
+values. Values flow through scatters/gathers at *rank* positions,
+which are private-working-memory accesses with exactly the standing
+the existing ``group_sort`` permutations already have (the EPC analog;
+see the threat-model notes in oram/path_oram.py and engine/vphases.py).
+
+Contract: ``radix_rank`` is bit-identical to
+``jnp.argsort(keys, stable=True)`` and ``radix_group_sort`` to
+``segmented.multiword_group_sort`` for keys within their declared
+bound (tests/test_radix.py). Keys must be *declared* bounded — there
+is deliberately no hash-down fallback for wide keys: a correctness
+property must never silently depend on a hash, so sorts over undeclared
+or >``MAX_RADIX_BITS`` keys stay on ``lax.sort`` (the 256-bit
+recipient-key sort in engine/vphases.py is the canonical example).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.phases import device_phase
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+#: ceiling on the total declared key width of one ``radix_group_sort``
+#: call. Wider keys (e.g. the 256-bit recipient pubkey) take more
+#: counting passes than a comparison sort is worth and must stay on
+#: ``lax.sort`` — the explicit refusal is the guard against "just hash
+#: it down", which would silently trade correctness for speed.
+MAX_RADIX_BITS = 64
+
+
+def _check_static(key_bits: int, bits_per_pass: int) -> None:
+    if not isinstance(key_bits, int) or not 1 <= key_bits <= 32:
+        raise ValueError(
+            f"key_bits must be an int in [1, 32], got {key_bits!r}"
+        )
+    if not isinstance(bits_per_pass, int) or not 1 <= bits_per_pass <= 16:
+        raise ValueError(
+            f"bits_per_pass must be an int in [1, 16], got {bits_per_pass!r}"
+        )
+
+
+def _check_declared_bound(keys, key_bits: int) -> None:
+    """Concrete (non-traced) keys are validated against the declared
+    bound — an out-of-range key would silently mis-rank (high bits never
+    enter any pass), so the eager path raises instead. Inside jit the
+    keys are tracers and the caller's declared bound is the contract."""
+    if key_bits >= 32 or isinstance(keys, jax.core.Tracer):
+        return
+    k = np.asarray(keys)
+    if k.size and int(k.max()) >> key_bits:
+        raise ValueError(
+            f"key {int(k.max())} exceeds the declared {key_bits}-bit bound"
+        )
+
+
+def _rank_pass(digit: jax.Array, nbins: int) -> jax.Array:
+    """Stable counting-sort positions for one digit column.
+
+    digit: i32[B] in [0, nbins). Returns i32[B] — a permutation of
+    [0, B): position j goes to ``offset[digit[j]] + (# i < j with
+    digit[i] == digit[j])``. No comparison sort, no bool/f32
+    intermediate wider than [B] (the jaxpr-audit discipline of
+    tests/test_vphases_scan.py), O(B·nbins) integer work.
+    """
+    b = digit.shape[0]
+    iota = jnp.arange(b, dtype=I32)
+    if nbins == 2:
+        # the 1-bit pass needs no bin table: two exclusive ranks
+        ones_before = jnp.cumsum(digit) - digit
+        zeros_before = iota - ones_before
+        n_zeros = b - (ones_before[-1] + digit[-1])
+        return jnp.where(digit == 1, n_zeros + ones_before, zeros_before)
+    # scatter-bincount one-hot (integer scatter — no [B, nbins] bool),
+    # inclusive cumsum down the batch axis, then two gathers: the last
+    # row is the per-bin total, the (j, digit[j]) entry the within-bin
+    # inclusive rank
+    oh = jnp.zeros((b, nbins), I32).at[iota, digit].set(
+        1, unique_indices=True
+    )
+    csum = jnp.cumsum(oh, axis=0)
+    within = jnp.take_along_axis(csum, digit[:, None], axis=1)[:, 0] - 1
+    counts = csum[-1]
+    offs = jnp.cumsum(counts) - counts  # exclusive bin offsets
+    return offs[digit] + within
+
+
+def partition_rank(flags) -> jax.Array:
+    """Positions of a stable two-way partition (False first): i32[B].
+
+    The 1-bit counting pass exposed directly — ``pos[i]`` is where
+    element i lands when all False-flagged elements precede all True
+    ones, each side in original order. The expiry sweep's freelist
+    rebuild is exactly this pass (engine/expiry.py).
+    """
+    return _rank_pass(jnp.asarray(flags).astype(I32), 2)
+
+
+def radix_rank(keys, key_bits: int, bits_per_pass: int = 8) -> jax.Array:
+    """Stable ascending permutation of bounded u32 keys: u32[B].
+
+    ``keys[perm]`` is sorted ascending with ties in original order —
+    bit-identical to ``jnp.argsort(keys, stable=True)`` for
+    ``keys < 2**key_bits`` — computed in ``ceil(key_bits /
+    bits_per_pass)`` counting passes with zero ``sort`` HLO ops.
+    """
+    _check_static(key_bits, bits_per_pass)
+    _check_declared_bound(keys, key_bits)
+    keys = jnp.asarray(keys).astype(U32)
+    b = keys.shape[0]
+    perm = jnp.arange(b, dtype=U32)
+    with device_phase("radix_rank"):
+        for shift in range(0, key_bits, bits_per_pass):
+            pbits = min(bits_per_pass, key_bits - shift)
+            with device_phase(f"radix_pass_s{shift}"):
+                cur = keys[perm]
+                digit = (
+                    (cur >> U32(shift)) & U32((1 << pbits) - 1)
+                ).astype(I32)
+                pos = _rank_pass(digit, 1 << pbits)
+                perm = jnp.zeros((b,), U32).at[pos].set(
+                    perm, unique_indices=True
+                )
+    return perm
+
+
+def radix_group_sort(cols, key_bits, bits_per_pass: int = 8):
+    """Drop-in for ``segmented.multiword_group_sort`` over declared-
+    bounded keys: ``(perm, inv, seg_start)``, bit-identical outputs.
+
+    ``cols``: sequence of u32[B] key words, most significant first.
+    ``key_bits``: the declared bit bound — an int for a single column,
+    else a sequence aligned with ``cols``. The total declared width
+    must not exceed ``MAX_RADIX_BITS``; wider keys raise so the caller
+    keeps ``lax.sort`` (never a hash). Stability of the LSD passes
+    makes the slot index an implicit final key, exactly like the iota
+    word ``multiword_group_sort`` appends.
+    """
+    cols = [jnp.asarray(c).astype(U32) for c in cols]
+    if not cols:
+        raise ValueError("radix_group_sort needs at least one key column")
+    bits = [key_bits] if isinstance(key_bits, int) else list(key_bits)
+    if len(bits) != len(cols):
+        raise ValueError(
+            f"key_bits must declare a bound per column: "
+            f"{len(bits)} bounds for {len(cols)} columns"
+        )
+    for kb in bits:
+        _check_static(kb, bits_per_pass)
+    if sum(bits) > MAX_RADIX_BITS:
+        raise ValueError(
+            f"declared key width {sum(bits)} exceeds MAX_RADIX_BITS="
+            f"{MAX_RADIX_BITS}; keep lax.sort for wide keys (hashing "
+            f"them down would make correctness depend on a hash)"
+        )
+    b = cols[0].shape[0]
+    perm = jnp.arange(b, dtype=U32)
+    with device_phase("radix_group_sort"):
+        # least-significant column first; each column's stable passes
+        # preserve the order established by the columns after it
+        for ci in range(len(cols) - 1, -1, -1):
+            c, kb = cols[ci], bits[ci]
+            _check_declared_bound(c, kb)
+            for shift in range(0, kb, bits_per_pass):
+                pbits = min(bits_per_pass, kb - shift)
+                cur = c[perm]
+                digit = (
+                    (cur >> U32(shift)) & U32((1 << pbits) - 1)
+                ).astype(I32)
+                pos = _rank_pass(digit, 1 << pbits)
+                perm = jnp.zeros((b,), U32).at[pos].set(
+                    perm, unique_indices=True
+                )
+    neq = jnp.zeros((b - 1,), jnp.bool_)
+    for c in cols:
+        sc = c[perm]
+        neq = neq | (sc[1:] != sc[:-1])
+    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
+    inv = jnp.zeros((b,), U32).at[perm].set(
+        jnp.arange(b, dtype=U32), unique_indices=True
+    )
+    return perm, inv, seg_start

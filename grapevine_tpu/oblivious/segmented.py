@@ -109,20 +109,30 @@ def segmented_exclusive_sat_scan(elems, seg_start):
     return excl
 
 
-def group_sort(group: jax.Array):
+def group_sort(group: jax.Array, sort_impl: str = "xla",
+               key_bits: int | None = None):
     """Stable permutation ordering ops by (group, slot).
 
     group: u32[B] group id per op (e.g. the first-occurrence slot of the
     op's key). Returns (perm, inv, seg_start_sorted):
     ``x[perm]`` is segment-contiguous, ``y[inv]`` undoes it, and
     seg_start marks group boundaries in sorted order.
+
+    ``sort_impl="radix"`` with a declared ``key_bits`` bound computes
+    the same permutation with counting passes instead of a comparison
+    sort (oblivious/radix.py) — bit-identical outputs, zero ``sort``
+    HLO; without a declared bound the XLA sort is kept.
     """
-    perm = jnp.argsort(group, stable=True)  # stable ⇒ slot order within groups
+    if sort_impl == "radix" and key_bits is not None:
+        from .radix import radix_group_sort
+
+        return radix_group_sort([group], key_bits)
+    perm = jnp.argsort(group, stable=True)  # stable ⇒ slot order
+    inv = jnp.argsort(perm)
     sorted_g = group[perm]
     seg_start = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), sorted_g[1:] != sorted_g[:-1]]
     )
-    inv = jnp.argsort(perm)
     return perm, inv, seg_start
 
 

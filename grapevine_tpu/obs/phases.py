@@ -22,6 +22,16 @@ Host-side phases (histograms + ``jax.profiler`` annotations):
 - ``journal``   — sealed batch-journal append + fsync (engine/journal.py)
 - ``checkpoint``— sealed whole-state checkpoint write (engine/checkpoint.py)
 - ``replay``    — startup journal replay (recovery; engine/batcher.py)
+- ``sort``      — the round's bounded-key sort workload, measured by
+                  calibration (GrapevineEngine.calibrate_sort_phase):
+                  the host cannot time inside the fused round program,
+                  but every sort in the round is shape-static and
+                  data-independent (oblivious), so a standalone run of
+                  the SAME jitted sort program at the round's geometry
+                  IS the per-round sort cost — /metrics separates it
+                  from the rest of the ``evict`` phase without touching
+                  the hot path. Labelled batch-level by construction
+                  (geometry only, never request data).
 
 Device-side scopes (``device_phase``): named_scope annotations compiled
 into the jit'd round so TPU profiler captures (tools/tpu_capture.py
@@ -36,7 +46,7 @@ import time
 #: canonical phase label values — the registry declares exactly these,
 #: so a typo'd phase name raises instead of minting a new series
 PHASES = ("assembly", "verify", "dispatch", "evict", "demux", "sweep",
-          "journal", "checkpoint", "replay")
+          "journal", "checkpoint", "replay", "sort")
 
 #: fixed histogram boundaries for phase durations (seconds). Spans the
 #: measured range: ~100 µs host phases at B=8 up to multi-second expiry

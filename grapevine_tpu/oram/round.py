@@ -53,6 +53,7 @@ from ..config import TPU_BACKENDS as _TPU_BACKENDS
 import jax.numpy as jnp
 
 from ..oblivious.primitives import SENTINEL, rank_of
+from ..oblivious.radix import radix_rank
 from ..oblivious.bucket_cipher import epoch_next
 from ..obs.phases import device_phase
 from .path_oram import (
@@ -92,16 +93,28 @@ def occurrence_masks(idxs: jax.Array, dummy_index: int):
     return first_occ, last_occ, chain_slot
 
 
-def occurrence_masks_sorted(idxs: jax.Array, dummy_index: int):
+def occurrence_masks_sorted(idxs: jax.Array, dummy_index: int,
+                            sort_impl: str = "xla",
+                            key_bits: int | None = None):
     """`occurrence_masks` in O(B log B): one sort by (index, slot), then
     segment boundaries in sorted order mark first/last occurrences — no
-    [B,B] intermediate (bit-identical outputs; tests/test_round.py)."""
+    [B,B] intermediate (bit-identical outputs; tests/test_round.py).
+
+    ``sort_impl="radix"`` with a declared ``key_bits`` bound (block
+    indices are ≤ log2(blocks)+1 bits — oram_round passes the bound
+    from its geometry) replaces the comparison sort with counting
+    passes (oblivious/radix.py); identical masks either way."""
     from ..oblivious.segmented import multiword_group_sort, segment_bounds
 
     b = idxs.shape[0]
     is_real = idxs != U32(dummy_index)
     slot_iota = jnp.arange(b, dtype=U32)
-    perm, inv, seg_start = multiword_group_sort([idxs])
+    if sort_impl == "radix" and key_bits is not None:
+        from ..oblivious.radix import radix_group_sort
+
+        perm, inv, seg_start = radix_group_sort([idxs], key_bits)
+    else:
+        perm, inv, seg_start = multiword_group_sort([idxs])
     start, end = segment_bounds(seg_start)
     iota_i = jnp.arange(b, dtype=jnp.int32)
     first_occ = is_real & ((iota_i == start)[inv])
@@ -139,6 +152,7 @@ def oram_round(
     apply_batch,
     axis_name: str | None = None,
     occ_impl: str = "dense",
+    sort_impl: str = "xla",
 ):
     """One batched oblivious access round over this ORAM.
 
@@ -161,6 +175,13 @@ def oram_round(
     ``occ_impl``: "dense" = [B,B]-mask dedup, "scan" = sorted dedup with
     no quadratic intermediate (bit-identical; matches the engine's
     ``vphases_impl`` knob).
+
+    ``sort_impl``: "xla" = the comparison sorts XLA lowers natively,
+    "radix" = bounded-key counting passes (oblivious/radix.py) for the
+    eviction leaf sort and the sorted dedup — bit-identical
+    permutations, zero ``sort`` HLO in this round (matches the engine's
+    ``GrapevineConfig.sort_impl`` knob; CI-audited in
+    tests/test_radix.py).
     """
     b = idxs.shape[0]
     z, v, plen, h = cfg.bucket_slots, cfg.value_words, cfg.path_len, cfg.height
@@ -168,8 +189,14 @@ def oram_round(
     nslots = b * plen * z
 
     # --- 1. dedup, position-map read/remap, path fetch -----------------
-    occ = occurrence_masks_sorted if occ_impl == "scan" else occurrence_masks
-    first_occ, last_occ, _ = occ(idxs, cfg.dummy_index)
+    if occ_impl == "scan":
+        # block indices are bounded: real < blocks, dummy = blocks
+        first_occ, last_occ, _ = occurrence_masks_sorted(
+            idxs, cfg.dummy_index, sort_impl=sort_impl,
+            key_bits=max(1, cfg.dummy_index.bit_length()),
+        )
+    else:
+        first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
     leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
     # last occurrence wins the remap; others drop out of bounds (the
     # dummy slot posmap[blocks] is never read unmasked, so funneling
@@ -280,7 +307,18 @@ def oram_round(
     with device_phase("oram_evict"):
         valid = widx != SENTINEL
         skey = jnp.where(valid, wleaf, U32(0xFFFFFFFF))
-        eperm = jnp.argsort(skey)
+        if sort_impl == "radix":
+            # leaves are h bits; invalid rows sort last under the 2^h
+            # sentinel exactly as they do under 0xFFFFFFFF (both stable
+            # sorts keep equal keys in working-set order), so the
+            # permutation is bit-identical to the argsort — at h+1
+            # declared key bits instead of a 32-bit comparison sort
+            with device_phase("oram_evict_sort"):
+                eperm = radix_rank(
+                    jnp.where(valid, wleaf, U32(1) << U32(h)), h + 1
+                )
+        else:
+            eperm = jnp.argsort(skey)
         sleaf = skey[eperm]
         svalid = valid[eperm]
         iota_w = jnp.arange(w, dtype=jnp.int32)

@@ -368,6 +368,11 @@ class GrapevineServer:
         wires ``--metrics-port`` here."""
         from ..obs import MetricsServer
 
+        if self.engine is not None:
+            try:  # populate the "sort" phase split before the first scrape
+                self.engine.calibrate_sort_phase()
+            except Exception:  # best-effort: metrics must still bind
+                pass
         lm = self.leakmon
         self._metrics_server = MetricsServer(
             self.metrics_registry,

@@ -197,6 +197,10 @@ class EngineServer:
         session-layer registry."""
         from ..obs import MetricsServer
 
+        try:  # populate the "sort" phase split before the first scrape
+            self.engine.calibrate_sort_phase()
+        except Exception:  # best-effort: metrics must still bind
+            pass
         lm = self.leakmon
         self._metrics_server = MetricsServer(
             self.engine.metrics.registry,

@@ -1,0 +1,188 @@
+"""Directed unit suite for the oblivious radix-rank engine.
+
+The primitive contract (oblivious/radix.py): ``radix_rank`` is
+bit-identical to ``jnp.argsort(keys, stable=True)`` and
+``radix_group_sort`` to ``segmented.multiword_group_sort`` for keys
+within their declared bound — stability on duplicates included — and
+the declared-bound guard raises on out-of-range concrete keys instead
+of silently mis-ranking. Engine-level integration (bit-identical
+rounds, zero-sort jaxpr audit) lives in tests/test_sort_radix.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grapevine_tpu.oblivious.radix import (
+    MAX_RADIX_BITS,
+    partition_rank,
+    radix_group_sort,
+    radix_rank,
+)
+from grapevine_tpu.oblivious.segmented import group_sort, multiword_group_sort
+
+U32 = np.uint32
+
+
+def _ref(keys):
+    return np.asarray(jnp.argsort(jnp.asarray(keys), stable=True))
+
+
+def _assert_rank_matches(keys, key_bits, bits_per_pass=8):
+    got = np.asarray(radix_rank(jnp.asarray(keys), key_bits, bits_per_pass))
+    np.testing.assert_array_equal(got, _ref(keys))
+
+
+def test_stability_on_duplicate_keys():
+    """Equal keys must keep original order — the property the eviction
+    permutation's bit-identity to the stable argsort rides on."""
+    keys = np.array([3, 1, 3, 1, 3, 2, 1, 2, 3, 0], U32)
+    for bpp in (1, 2, 8):
+        _assert_rank_matches(keys, key_bits=2, bits_per_pass=bpp)
+    # heavy duplication: 4 distinct values over 512 slots
+    rng = np.random.default_rng(0)
+    _assert_rank_matches(rng.integers(0, 4, 512).astype(U32), 2)
+
+
+def test_all_equal_keys_identity():
+    for b in (1, 2, 97):
+        got = np.asarray(radix_rank(jnp.full((b,), 5, jnp.uint32), 3))
+        np.testing.assert_array_equal(got, np.arange(b))
+
+
+def test_max_key_saturation():
+    """Keys AT the declared bound's ceiling (2^bits - 1) rank correctly
+    — the top bin of the last pass."""
+    for kb in (1, 7, 8, 21, 32):
+        mx = (1 << kb) - 1
+        keys = np.array([mx, 0, mx, mx - 1 if kb > 0 else 0, 0, mx], U32)
+        _assert_rank_matches(keys, kb)
+    # all-saturated
+    _assert_rank_matches(np.full(33, (1 << 21) - 1, U32), 21)
+
+
+def test_key_bits_1_edge():
+    rng = np.random.default_rng(1)
+    for b in (1, 2, 5, 256):
+        keys = rng.integers(0, 2, b).astype(U32)
+        for bpp in (1, 8):
+            _assert_rank_matches(keys, 1, bpp)
+
+
+def test_randomized_bounded_draws_match_stable_argsort():
+    rng = np.random.default_rng(2)
+    for b in (1, 3, 17, 256, 1000):
+        for kb in (1, 5, 8, 13, 21, 32):
+            hi = ((1 << kb) - 1) if kb < 64 else (1 << 32) - 1
+            keys = rng.integers(0, hi + 1, b, dtype=np.uint64).astype(U32)
+            for bpp in (1, 5, 8, 11):
+                _assert_rank_matches(keys, kb, bpp)
+
+
+def test_declared_bound_guard_raises_on_out_of_range():
+    with pytest.raises(ValueError, match="exceeds the declared"):
+        radix_rank(np.array([9], U32), key_bits=3)
+    with pytest.raises(ValueError, match="exceeds the declared"):
+        radix_group_sort([np.array([0, 1 << 13], U32)], 13)
+    # in-range keys at the same width pass
+    radix_rank(np.array([7], U32), key_bits=3)
+
+
+def test_static_parameter_guards():
+    k = np.array([0, 1], U32)
+    for bad_bits in (0, 33, -1, 8.0, None):
+        with pytest.raises(ValueError):
+            radix_rank(k, bad_bits)
+    for bad_bpp in (0, 17, -3):
+        with pytest.raises(ValueError):
+            radix_rank(k, 8, bad_bpp)
+    with pytest.raises(ValueError):
+        radix_group_sort([], 8)
+    with pytest.raises(ValueError, match="per column"):
+        radix_group_sort([k, k], [8])
+
+
+def test_wide_key_refusal_not_hashing():
+    """radix refuses > MAX_RADIX_BITS declared width — the explicit gate
+    that keeps the 256-bit recipient-key sort on lax.sort rather than on
+    a hashed-down key (engine/vphases.py)."""
+    cols = [np.zeros(4, U32)] * 9
+    assert 9 * 32 > MAX_RADIX_BITS
+    with pytest.raises(ValueError, match="MAX_RADIX_BITS"):
+        radix_group_sort(cols, [32] * 9)
+
+
+def test_group_sort_radix_matches_multiword():
+    rng = np.random.default_rng(3)
+    for b in (1, 2, 64, 700):
+        cases = [
+            ([rng.integers(0, 7, b).astype(U32)], [3]),
+            ([rng.integers(0, 1 << 13, b).astype(U32)], [13]),
+            (
+                [
+                    rng.integers(0, 3, b).astype(U32),
+                    rng.integers(0, 1 << 11, b).astype(U32),
+                ],
+                [2, 11],
+            ),
+        ]
+        for cols, bits in cases:
+            jc = [jnp.asarray(c) for c in cols]
+            ref = multiword_group_sort(jc)
+            got = radix_group_sort(jc, bits)
+            for r, g in zip(ref, got):
+                np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # int shorthand for a single column
+    c = rng.integers(0, 31, 50).astype(U32)
+    ref = multiword_group_sort([jnp.asarray(c)])
+    got = radix_group_sort([jnp.asarray(c)], 5)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_segmented_group_sort_knob_bitequal():
+    """segmented.group_sort under sort_impl='radix' (the admission
+    walk's grouping, engine/vphases.py) equals the stable-argsort path."""
+    rng = np.random.default_rng(4)
+    for b in (1, 8, 256):
+        g = rng.integers(0, max(1, b // 3) + 1, b).astype(U32)
+        a = group_sort(jnp.asarray(g))
+        r = group_sort(
+            jnp.asarray(g), sort_impl="radix",
+            key_bits=max(1, (b - 1).bit_length()),
+        )
+        for x, y in zip(a, r):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_partition_rank_is_the_freelist_formula():
+    """partition_rank == the expiry sweep's stable free-first partition
+    (engine/expiry.py) == the inverse of radix_rank at key_bits=1."""
+    rng = np.random.default_rng(5)
+    for n in (1, 2, 100, 1023):
+        present = rng.random(n) < 0.4
+        pos = np.asarray(partition_rank(jnp.asarray(present)))
+        pi = present.astype(np.int64)
+        n_free = n - pi.sum()
+        ref = np.where(
+            present,
+            n_free + (np.cumsum(pi) - pi),
+            np.cumsum(1 - pi) - (1 - pi),
+        )
+        np.testing.assert_array_equal(pos, ref)
+        # inverse relation: scattering iota at pos gives the stable
+        # ascending permutation of the 1-bit keys
+        perm = np.asarray(radix_rank(jnp.asarray(present), 1))
+        inv = np.zeros(n, np.int64)
+        inv[pos] = np.arange(n)
+        np.testing.assert_array_equal(perm, inv)
+
+
+def test_traced_path_skips_concrete_guard():
+    """Inside jit the keys are tracers — the declared bound is the
+    caller's contract and tracing must not fail (the guard is for the
+    eager/test path)."""
+    f = jax.jit(lambda k: radix_rank(k, 4))
+    out = np.asarray(f(jnp.asarray(np.array([3, 1, 2, 1], U32))))
+    np.testing.assert_array_equal(out, [1, 3, 2, 0])

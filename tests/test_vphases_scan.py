@@ -144,14 +144,20 @@ def _gen_batch(rng, idents, live_ids, n):
     return reqs
 
 
-def _run_campaign(cfg_kwargs, seed, n_batches=3, batch_fill=None):
-    """One campaign: fresh dense/scan engines + oracle, mixed batches.
+def _run_campaign(cfg_kwargs, seed, n_batches=3, batch_fill=None,
+                  mk_pair=None):
+    """One campaign: a fresh engine A/B pair + oracle, mixed batches.
 
-    Asserts dense ≡ scan bitwise (responses, then final state) and both
+    Asserts pair ≡ bitwise (responses, then final state) and both
     ≡ oracle semantics (forced-id comparison, counts included).
+    ``mk_pair`` builds the (a, b) engines under test — default the
+    dense/scan vphases pair; tests/test_sort_radix.py reuses the whole
+    campaign with an xla/radix sort pair instead.
     """
     rng = np.random.default_rng(seed)
-    dense, scan = _mk_pair(cfg_kwargs, seed=int(rng.integers(1 << 30)))
+    dense, scan = (mk_pair or _mk_pair)(
+        cfg_kwargs, seed=int(rng.integers(1 << 30))
+    )
     oracle = ReferenceEngine(
         config=GrapevineConfig(**cfg_kwargs), rng=random.Random(seed)
     )

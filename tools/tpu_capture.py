@@ -16,6 +16,9 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
 4b. vphases_perf — dense vs scan slot-order machinery A/B (decides the
                  per-backend vphases_impl default, incl. the B=4096
                  dense-memory-wall probe)
+4c. sort_perf  — xla vs radix bounded-key sort engine A/B (decides the
+                 device sort_impl default: serial-scatter-bound on CPU,
+                 open question on TPU where scatters vectorize)
 5. oblivious   — transcript equality + R/U/D timing z-scores from
                  TPU-executed rounds (tiny capacity; it is the compiled
                  schedule being tested, not scale)
@@ -82,11 +85,12 @@ def stage_probe(cap, args):
 
 
 def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
-              vphases=None):
+              vphases=None, sort=None):
     """zipf_mixed through a chosen cipher impl at a chosen size, using
     bench.py's own machinery (same methodology as the driver bench).
-    ``vphases`` selects the slot-order machinery ("dense"/"scan"; None =
-    the backend default)."""
+    ``vphases`` selects the slot-order machinery ("dense"/"scan"),
+    ``sort`` the bounded-key sort engine ("xla"/"radix"); None = the
+    backend default for each."""
     import jax
     import numpy as np
 
@@ -95,7 +99,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     t0 = time.perf_counter()
     cfg, ecfg, state, step = bench._mk_engine(
         1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl,
-        vphases_impl=vphases,
+        vphases_impl=vphases, sort_impl=sort,
     )
     batches = bench.make_batches(4, batch)
     compile_t0 = time.perf_counter()
@@ -105,6 +109,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     _, times, total = bench._run_rounds(ecfg, state, step, batches[1:], n_rounds)
     ops = batch * n_rounds
     cap.emit(stage_name, impl=impl, vphases=ecfg.vphases_impl,
+             sort=ecfg.sort_impl,
              capacity_log2=cap_log2, batch=batch,
              rounds=n_rounds, ops_per_sec=round(ops / total, 1),
              p99_round_ms=round(bench._p99(times), 2),
@@ -285,6 +290,34 @@ def stage_vphases_perf(cap, args):
         _zipf_run(cap, "vphases_perf", "jnp", 20, 4096, 8, vphases="dense")
 
 
+def stage_sort_perf(cap, args):
+    """xla vs radix bounded-key sort engine ON TPU — the A/B that
+    decides the device ``sort_impl`` default (config.py; currently xla
+    everywhere: on XLA:CPU the serial native sort wins because every
+    radix pass pays a serial scatter, but on TPU scatters vectorize
+    while lax.sort lowers to an O(n log² n) bitonic network — the open
+    question only a real chip answers; PERF.md Round 7). Mirrors
+    ``vphases_perf``: identical workload, the knob the only difference,
+    bit-identical impls (tests/test_sort_radix.py) so the faster one
+    simply wins. Runs under vphases "scan" so the bounded group sorts
+    are in the round, plus one "dense" pair (the admission walk's
+    grouping sort follows the knob under both impls), plus the isolated
+    machinery A/B from bench ``sort_ab`` at device working-set sizes."""
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "sort_perf", "jnp", cl, b, 8, vphases="scan", sort="xla")
+    _zipf_run(cap, "sort_perf", "jnp", cl, b, 8, vphases="scan", sort="radix")
+    if not args.quick:
+        _zipf_run(cap, "sort_perf", "jnp", cl, b, 8, vphases="dense",
+                  sort="xla")
+        _zipf_run(cap, "sort_perf", "jnp", cl, b, 8, vphases="dense",
+                  sort="radix")
+        # the isolated machinery numbers (min-of-N, both scopes) — the
+        # clean separation the whole round dilutes with gather traffic
+        import bench
+
+        cap.emit("sort_perf", machinery=bench.bench_sort_ab(smoke=False))
+
+
 def stage_oblivious(cap, args):
     """SURVEY §7 hard-part 2 on the real device: R/U/D transcript
     equality + timing uniformity, reusing the CPU suite's EXACT
@@ -397,6 +430,7 @@ STAGES = [
     ("trace", stage_trace, 900),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
+    ("sort_perf", stage_sort_perf, 1800),
     ("oblivious", stage_oblivious, 900),
     ("fullbench", None, 2400),  # subprocess-only (see main loop)
 ]
