@@ -21,6 +21,11 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
   6. server_loopback     full-stack gRPC: session crypto + batched
                          verification + pipelined scheduler + engine
                          (skipped, not errored, without `cryptography`)
+  7. slo_loopback        scheduler loopback with the observability
+                         stack on (round tracer + commit-latency SLO,
+                         PR6): enqueue→settle latency, burn rates, and
+                         the host/device bubble ratio — runs everywhere
+                         (no session crypto in the loop)
 
 stdout is ONE JSON line: the headline mixed-CRUD throughput at the
 largest batched config, with every config's (ops/s, p99 round ms)
@@ -928,6 +933,115 @@ def bench_server_loopback(smoke):
         server.stop()
 
 
+def bench_slo_loopback(smoke):
+    """Config 7: concurrent submitters through the BatchScheduler into
+    the engine with the PR-6 observability stack attached (round tracer
+    + commit-latency SLO tracker) — the end-to-end *commit latency* a
+    client observes (enqueue → round settle), which is what the SLO
+    engine gates on, plus the derived bubble ratio that sizes the
+    pipelined-round refactor (ROADMAP item 2). No session crypto in the
+    loop, so unlike ``server_loopback`` this runs in every container;
+    the observability overhead rides every round exactly as it does in
+    production (`EngineServer` attaches the same stack)."""
+    import threading
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.obs.slo import SloConfig, SloTracker
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cap, n_clients, per_client, batch = (
+        (1 << 10, 2, 6, 4) if smoke else (1 << 16, 8, 48, 16)
+    )
+    cfg = GrapevineConfig(
+        max_messages=cap, max_recipients=1 << 10, batch_size=batch,
+        bucket_cipher_rounds=0 if smoke else 8,
+    )
+    engine = GrapevineEngine(cfg)
+    tracer = RoundTracer(capacity=256, registry=engine.metrics.registry)
+    engine.attach_tracer(tracer)
+    slo = SloTracker(SloConfig(), registry=engine.metrics.registry)
+    engine.attach_slo(slo)
+    sched = BatchScheduler(engine, clock=lambda: NOW)
+    try:
+        rng = np.random.default_rng(17)
+        idents = rng.integers(1, 256, (n_clients, 32)).astype(np.uint8)
+        # recipients rotate through a pool wide enough that no mailbox
+        # approaches the 62-message cap across warm-up + timed sends
+        recips = rng.integers(1, 256, (64, 32)).astype(np.uint8)
+        errs: list = []
+        lat: list[float] = []
+        lock = threading.Lock()
+
+        def run(j):
+            me = idents[j].tobytes()
+            try:
+                for i in range(per_client):
+                    req = QueryRequest(
+                        request_type=C.REQUEST_TYPE_CREATE,
+                        auth_identity=me,
+                        auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                        record=RequestRecord(
+                            msg_id=C.ZERO_MSG_ID,
+                            recipient=recips[
+                                (j * per_client + i) % len(recips)
+                            ].tobytes(),
+                            payload=bytes([i & 0xFF]) * C.PAYLOAD_SIZE,
+                        ),
+                    )
+                    t0 = time.perf_counter()
+                    r = sched.submit(req)
+                    assert r.status_code == C.STATUS_CODE_SUCCESS, r.status_code
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        # one warm-up op pays the compile outside the timed window (the
+        # SLO tracker sees it too — exactly the cold-start breach the
+        # min_rounds gate exists to not page on)
+        warm = sched.submit(QueryRequest(
+            request_type=C.REQUEST_TYPE_CREATE,
+            auth_identity=idents[0].tobytes(),
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID, recipient=recips[0].tobytes(),
+                payload=b"\x00" * C.PAYLOAD_SIZE,
+            ),
+        ))
+        assert warm.status_code == C.STATUS_CODE_SUCCESS
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run, args=(j,))
+                   for j in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = time.perf_counter() - t0
+        assert not errs, errs[0]
+        verdict = slo.verdict()
+        trace = tracer.chrome_trace()
+        ops = n_clients * per_client
+        return {
+            "ops_per_sec": round(ops / total, 1),
+            "p99_commit_ms": round(_p99(lat), 2),
+            "median_commit_ms": round(float(np.median(lat)) * 1e3, 2),
+            "bubble_ratio": trace["otherData"]["bubble_ratio"],
+            "trace_rounds": trace["otherData"]["rounds_recorded_total"],
+            "slo_target_ms": verdict["target_ms"],
+            "slo_ok": verdict["ok"],
+            "fast_burn_rate": verdict["fast_burn_rate"],
+            "slow_burn_rate": verdict["slow_burn_rate"],
+            "clients": n_clients, "batch": batch,
+            "capacity_log2": cap.bit_length() - 1,
+        }
+    finally:
+        sched.close()
+
+
 # Headline config FIRST: if the run later hits a budget wall or the
 # driver's own timeout, the metric that matters is already captured
 # (VERDICT r3, next-round #1b).
@@ -944,6 +1058,7 @@ CONFIGS = [
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),
+    ("slo_loopback", bench_slo_loopback),
 ]
 
 

@@ -121,10 +121,10 @@ class PendingRound:
     """Handle to a dispatched-but-unsynced round; ``resolve()`` blocks."""
 
     __slots__ = ("_engine", "_resp", "_n", "_t0", "_transcript", "_batch",
-                 "_phases")
+                 "_spans", "_enq")
 
     def __init__(self, engine, resp, n, t0, transcript=None, batch=None,
-                 phases=None):
+                 spans=None):
         self._engine = engine
         self._resp = resp
         self._n = n
@@ -132,10 +132,32 @@ class PendingRound:
         #: leak-monitor hand-off (engine.leakmon set): the round's public
         #: transcript (still a device array — the copy happens on the
         #: monitor thread) plus the host-side batch dict its key groups
-        #: derive from, and the per-round phase durations so far
+        #: derive from
         self._transcript = transcript
         self._batch = batch
-        self._phases = phases
+        #: {phase: (start_s, dur_s)} spans recorded so far on the
+        #: perf_counter clock (dispatch/journal/checkpoint) — the round
+        #: tracer's ledger accumulates here, and the leak monitor's
+        #: phase durations derive from it
+        self._spans = spans
+        #: perf_counter enqueue time of the round's OLDEST op, stamped
+        #: by the scheduler (set_enqueued_at) — the SLO's enqueue→settle
+        #: anchor; None on the direct (schedulerless) path
+        self._enq = None
+
+    def set_enqueued_at(self, t_enq: float) -> None:
+        """Stamp the oldest op's enqueue time (perf_counter seconds);
+        must be called before ``resolve()``."""
+        self._enq = t_enq
+
+    def note_span(self, name: str, start_s: float, dur_s: float) -> None:
+        """Add a collector-side span (assembly/verify) to this round's
+        ledger — exact pairing even under the pipelined scheduler, where
+        a staged hand-off would attach round k+1's window to round k.
+        Must be called before ``resolve()``."""
+        if self._spans is None:
+            self._spans = {}
+        self._spans[name] = (start_s, dur_s)
 
     def resolve(self) -> list[QueryResponse]:
         m = self._engine.metrics
@@ -156,14 +178,37 @@ class PendingRound:
         # round *commit latency* a client observes, not pure device time
         bs = self._engine.ecfg.batch_size
         m.record_round(self._n, bs, t_done - self._t0)
+        spans = dict(self._spans or {})
+        spans["evict"] = (t_ev, t_dm - t_ev)
+        spans["demux"] = (t_dm, t_done - t_dm)
+        # the host-observed device window (async enqueue → readiness
+        # OBSERVED at resolve), emitted on EVERY config — durability on
+        # or off — so the trace JSON shape is stable across configs
+        # (obs/tracer.py zero-fills the journal/checkpoint spans it
+        # never sees). Under the pipelined scheduler resolve runs after
+        # the next round's collection window, so this is an UPPER bound
+        # on device-busy time — exact only when the evict wait is
+        # nonzero (the device was still running when the host arrived)
+        spans["device"] = (self._t0, t_dm - self._t0)
+        r0 = min(s for s, _ in spans.values())
+        spans["round"] = (r0, t_done - r0)
+        tracer = self._engine.tracer
+        if tracer is not None:
+            # a few dict ops + schema check; the ring is lock-cheap
+            tracer.record_round(spans)
+        slo = self._engine.slo
+        if slo is not None:
+            # enqueue→settle commit latency, worst op in the batch: the
+            # scheduler stamped the oldest op's enqueue; the direct path
+            # anchors at dispatch start (no queue wait to account)
+            slo.observe(t_done - (self._enq if self._enq is not None else r0))
         lm = self._engine.leakmon
         if lm is not None and self._transcript is not None:
             # one non-blocking queue put; detectors run on the monitor's
-            # own thread (obs/leakmon.py), never on the round path
-            phases = dict(self._phases or {})
-            phases["evict"] = t_dm - t_ev
-            phases["demux"] = t_done - t_dm
-            phases["round"] = t_done - self._t0
+            # own thread (obs/leakmon.py), never on the round path.
+            # "device" stays tracer-only — the flightrec phase schema is
+            # the canonical PHASES (+ round)
+            phases = {k: d for k, (_, d) in spans.items() if k != "device"}
             lm.submit_round(self._batch, self._transcript, self._n, bs,
                             phases)
         return out
@@ -195,6 +240,11 @@ class GrapevineEngine:
         #: streaming obliviousness auditor (obs/leakmon.py), attached by
         #: the serving layer when --leakmon is on; None = no monitoring
         self.leakmon = None
+        #: round-trace profiler (obs/tracer.py) and commit-latency SLO
+        #: tracker (obs/slo.py), attached by the serving layer; None =
+        #: rounds are not traced / measured against an SLO
+        self.tracer = None
+        self.slo = None
         #: crash safety (engine/checkpoint.py): with a DurabilityConfig,
         #: every admitted batch is journaled before dispatch and the
         #: whole state checkpointed every N records; construction runs
@@ -241,6 +291,16 @@ class GrapevineEngine:
         """Attach an EngineLeakMonitor; subsequent rounds hand their
         transcripts to it off the jit path (PendingRound.resolve)."""
         self.leakmon = monitor
+
+    def attach_tracer(self, tracer) -> None:
+        """Attach a RoundTracer; subsequent rounds append their span
+        ledgers to its ring (PendingRound.resolve)."""
+        self.tracer = tracer
+
+    def attach_slo(self, slo) -> None:
+        """Attach an SloTracker; subsequent rounds observe their
+        enqueue→settle commit latency against it."""
+        self.slo = slo
 
     def calibrate_sort_phase(self, reps: int = 5) -> float:
         """Measure the round's bounded-key sort workload standalone and
@@ -388,14 +448,15 @@ class GrapevineEngine:
             # its fsync is genuinely part of the commit latency (the
             # "journal" series isolates it).
             t_d0 = time.perf_counter()
+            spans: dict = {}
             with self.metrics.time_phase("dispatch"):
                 batch = pack_batch(reqs, bs, now)
                 if self.durability is not None:
                     t_j0 = time.perf_counter()
                     self.durability.append_round(batch, len(reqs))
-                    self.metrics.observe_phase(
-                        "journal", time.perf_counter() - t_j0
-                    )
+                    j_s = time.perf_counter() - t_j0
+                    self.metrics.observe_phase("journal", j_s)
+                    spans["journal"] = (t_j0, j_s)
                 t0 = time.perf_counter()
                 self.state, resp, transcript = self._step(
                     self.ecfg, self.state, batch
@@ -405,11 +466,13 @@ class GrapevineEngine:
             if self.durability is not None and self.durability.should_checkpoint():
                 # blocks this round's slot until the sealed state is on
                 # disk — the RTO/RPO trade --checkpoint-every-rounds buys
+                t_c0 = time.perf_counter()
                 with self.metrics.time_phase("checkpoint"):
                     self.durability.checkpoint(self.state)
-            dispatch_s = time.perf_counter() - t_d0
+                spans["checkpoint"] = (t_c0, time.perf_counter() - t_c0)
+            spans["dispatch"] = (t_d0, time.perf_counter() - t_d0)
         if lm is None:
-            return PendingRound(self, resp, len(reqs), t0)
+            return PendingRound(self, resp, len(reqs), t0, spans=spans)
         # hand the monitor only the key-material columns: retaining the
         # full batch dict would pin the (B, PAYLOAD_WORDS) payload array
         # in the monitor queue for grouping that never reads it
@@ -418,8 +481,7 @@ class GrapevineEngine:
         }
         return PendingRound(
             self, resp, len(reqs), t0,
-            transcript=transcript, batch=key_cols,
-            phases={"dispatch": dispatch_s},
+            transcript=transcript, batch=key_cols, spans=spans,
         )
 
     def handle_queries_with_transcript(self, reqs, now):

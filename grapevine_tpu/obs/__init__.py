@@ -24,7 +24,15 @@ leak policy structurally rather than by convention:
   window of production rounds, publishing aggregate-only statistics
   and a machine-readable PASS/SUSPECT verdict;
 - ``flightrec``: a fixed-size ring of schema-checked per-round
-  summaries, dumped on demand or on a PASS→SUSPECT transition.
+  summaries, dumped on demand or on a PASS→SUSPECT transition;
+- ``tracer``: the round-trace profiler — a fixed ring of per-round
+  span ledgers exported as Chrome trace-event JSON (``/trace``,
+  Perfetto-loadable) plus the derived host/device bubble-ratio gauge;
+- ``slo``: end-to-end commit-latency SLOs (enqueue→settle, one sample
+  per round) with multi-window burn-rate alerting folded into
+  ``/healthz``;
+- ``profiler``: gated programmatic ``jax.profiler`` capture of a live
+  engine (``/profile?ms=N``, ``--profile-enable``).
 """
 
 from .registry import (  # noqa: F401
@@ -45,3 +53,32 @@ from .leakmon import (  # noqa: F401
     LeakMonitorConfig,
     TranscriptLeakMonitor,
 )
+from .tracer import RoundTracer  # noqa: F401
+from .slo import SloConfig, SloTracker  # noqa: F401
+from .profiler import ProfilerBusy, ProfilerGate  # noqa: F401
+
+
+def attach_round_observability(engine, registry, *, trace_ring_size=512,
+                               slo=None, profile_enable=False):
+    """Attach the round tracer + commit-latency SLO (always on for the
+    device owner — both cost a few dict ops per ROUND, not per op) and
+    the optional profiler gate to ``engine``; the ONE place the serving
+    layers (server/service.py, server/tier.py) share the policy.
+
+    No explicit SLO config = observe-only (the CLI-default contract,
+    server/cli.py ``_slo_config``): latencies and burn rates export,
+    but /healthz only gates once an operator-supplied config enforces
+    a target. The jax.profiler capture gate stays opt-in
+    (``--profile-enable``): a capture has real overhead and writes
+    device traces to disk.
+
+    Returns ``(tracer, slo_tracker, profiler_or_None)``.
+    """
+    tracer = RoundTracer(capacity=trace_ring_size, registry=registry)
+    engine.attach_tracer(tracer)
+    slo_tracker = SloTracker(
+        slo if slo is not None else SloConfig(enforce=False),
+        registry=registry,
+    )
+    engine.attach_slo(slo_tracker)
+    return tracer, slo_tracker, ProfilerGate() if profile_enable else None

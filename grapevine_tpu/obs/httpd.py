@@ -1,5 +1,5 @@
-"""The /metrics + /healthz (+ /leakaudit, /flightrec) endpoint: a
-stdlib http.server thread.
+"""The /metrics + /healthz (+ /leakaudit, /flightrec, /trace,
+/profile) endpoint: a stdlib http.server thread.
 
 Deliberately not a gRPC method on the public service: scrapers and
 load-balancer health checks speak plain HTTP, and the endpoint must stay
@@ -41,6 +41,15 @@ class MetricsServer:
     probe can alert without parsing. ``flightrec`` is a zero-arg
     callable returning the flight recorder dump dict (obs/flightrec.py)
     — served on ``/flightrec``. Both 404 when not configured.
+
+    ``trace`` is a zero-arg callable returning Chrome trace-event JSON
+    as a dict (obs/tracer.py RoundTracer.chrome_trace) — served on
+    ``/trace``, loadable directly in Perfetto. ``profile`` is a
+    one-arg callable ``(ms) -> dict`` running a live ``jax.profiler``
+    capture (obs/profiler.py ProfilerGate.capture) — served on
+    ``/profile?ms=N``; a second concurrent request gets 409. Both 404
+    when not configured (``profile`` exists only behind
+    ``--profile-enable``).
     """
 
     def __init__(
@@ -52,11 +61,15 @@ class MetricsServer:
         port: int = 9464,
         leakaudit=None,
         flightrec=None,
+        trace=None,
+        profile=None,
     ):
         self.registry = registry
         self.health = health or (lambda: (True, {}))
         self.leakaudit = leakaudit
         self.flightrec = flightrec
+        self.trace = trace
+        self.profile = profile
         #: optional zero-arg pre-scrape hook: sample pull-style gauges
         #: (stash occupancy needs a device sync, which must happen at
         #: scrape cadence, not round cadence). Runs only for /metrics —
@@ -124,6 +137,41 @@ class MetricsServer:
                     self._reply(
                         200, json.dumps(dump).encode(), "application/json"
                     )
+                elif path == "/trace" and outer.trace is not None:
+                    try:
+                        trace = outer.trace()
+                    except Exception as exc:
+                        self._reply(500, repr(exc).encode(), "text/plain")
+                        return
+                    self._reply(
+                        200, json.dumps(trace).encode(), "application/json"
+                    )
+                elif path == "/profile" and outer.profile is not None:
+                    from urllib.parse import parse_qs, urlparse
+
+                    from .profiler import ProfilerBusy
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        ms = int(qs.get("ms", ["1000"])[0])
+                    except ValueError:
+                        self._reply(400, b"ms must be an integer\n",
+                                    "text/plain")
+                        return
+                    try:
+                        # blocks this handler thread for ~ms while the
+                        # engine keeps serving (ThreadingHTTPServer:
+                        # scrapes stay live on their own threads)
+                        result = outer.profile(ms)
+                    except ProfilerBusy as exc:
+                        self._reply(409, str(exc).encode(), "text/plain")
+                        return
+                    except Exception as exc:
+                        self._reply(500, repr(exc).encode(), "text/plain")
+                        return
+                    self._reply(
+                        200, json.dumps(result).encode(), "application/json"
+                    )
                 else:
                     self._reply(404, b"not found\n", "text/plain")
 
@@ -135,9 +183,11 @@ class MetricsServer:
         )
         self._thread.start()
         port = self._httpd.server_address[1]
-        log.info("metrics endpoint on %s:%d (/metrics, /healthz%s)",
+        log.info("metrics endpoint on %s:%d (/metrics, /healthz%s%s%s)",
                  self._host, port,
-                 ", /leakaudit, /flightrec" if self.leakaudit else "")
+                 ", /leakaudit, /flightrec" if self.leakaudit else "",
+                 ", /trace" if self.trace else "",
+                 ", /profile" if self.profile else "")
         return port
 
     @property

@@ -433,11 +433,6 @@ class EngineLeakMonitor:
         self._seq = 0
         self._suspect = False
         self._last_verdict: dict | None = None
-        #: most recent scheduler-side phase durations (assembly/verify),
-        #: merged into the next round's flight-recorder summary. Plain
-        #: dict writes from the collector thread; pairing with a round
-        #: is approximate under pipelining, which is fine for forensics.
-        self._host_phases: dict[str, float] = {}
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="grapevine-leakmon"
         )
@@ -472,11 +467,6 @@ class EngineLeakMonitor:
             return False
         self._submitted += 1
         return True
-
-    def note_phase(self, phase: str, seconds: float) -> None:
-        """Record a scheduler-side phase duration (assembly/verify) for
-        the next flight-recorder summary."""
-        self._host_phases[phase] = seconds
 
     # -- verdict views --------------------------------------------------
 
@@ -563,15 +553,15 @@ class EngineLeakMonitor:
         if self._g_suspect is not None:
             self._g_suspect.set(1.0 if suspect else 0.0)
 
-        merged = dict(self._host_phases)
-        merged.update(phases)
+        # phases arrive exact-paired on the round's own span ledger
+        # (engine/batcher.py PendingRound) — assembly/verify included
         self.recorder.record({
             "seq": self._seq,
             "t_mono_s": round(time.monotonic(), 3),
             "batch_size": int(batch_size),
             "n_real": int(n_real),
             "fill": round(n_real / batch_size, 4) if batch_size else 0.0,
-            "phase_s": {k: round(float(x), 6) for k, x in merged.items()},
+            "phase_s": {k: round(float(x), 6) for k, x in phases.items()},
             "stats": {t: self.monitor.stats(t) for t in ("rec", "mb")},
             "verdict": v["verdict"],
         })

@@ -151,6 +151,40 @@ def build_parser() -> argparse.ArgumentParser:
         "serves the ring on demand)",
     )
     p.add_argument(
+        "--trace-ring-size",
+        type=int,
+        default=512,
+        help="per-round span ledgers retained by the round tracer "
+        "(obs/tracer.py): /trace serves them as Perfetto-loadable "
+        "Chrome trace JSON and grapevine_round_bubble_ratio derives "
+        "from them. Spans are phases, never operations — the PR-1/2 "
+        "leak policy, enforced structurally. Device-owning roles only",
+    )
+    p.add_argument(
+        "--slo-commit-p99-ms",
+        type=float,
+        default=None,
+        help="end-to-end commit-latency SLO target in ms (enqueue → "
+        "round settle, worst op per round). Multi-window burn rates "
+        "over a 1%% error budget fold into /healthz: both windows "
+        "burning = 503 = stop routing (OPERATIONS.md §12). Unset = "
+        "observe-only: latencies, burn rates, and grapevine_slo_alert "
+        "still export against a 250 ms reference target, but /healthz "
+        "never gates on them — setting a target is the explicit "
+        "operator decision to let a breach pull the replica from "
+        "routing. Device-owning roles only — latency commits on the "
+        "engine",
+    )
+    p.add_argument(
+        "--profile-enable",
+        action="store_true",
+        help="expose /profile?ms=N on the metrics endpoint: a live "
+        "jax.profiler capture of the serving process (one at a time, "
+        "duration-clamped; obs/profiler.py). Off by default — a "
+        "capture costs real overhead and writes device traces to "
+        "disk. Device-owning roles only",
+    )
+    p.add_argument(
         "--state-dir",
         help="crash safety: directory for sealed checkpoints + the "
         "batch journal (engine/checkpoint.py). Every admitted batch is "
@@ -212,16 +246,22 @@ _DURABILITY_FLAGS = {"state_dir", "checkpoint_every_rounds",
                      "journal_fsync_every", "seal_key_file",
                      "worker_restart"}
 
+#: round tracing, the commit-latency SLO, and live profiler capture all
+#: observe the device round, so only device-owning roles take them — a
+#: frontend supplying --slo-commit-p99-ms would silently measure nothing
+_TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
+                    "profile_enable"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
              "metrics_port", "metrics_host"}
-            | _LEAKMON_FLAGS | _DURABILITY_FLAGS,
+            | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
                "seed", "verbose", "role", "metrics_port", "metrics_host"}
-              | _LEAKMON_FLAGS | _DURABILITY_FLAGS,
+              | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
@@ -260,6 +300,19 @@ def _install_drain_handlers(drain):
 
     signal.signal(signal.SIGTERM, _handler)
     signal.signal(signal.SIGINT, _handler)
+
+
+def _slo_config(args):
+    """The SloConfig for --slo-commit-p99-ms (always built for
+    device-owning roles; the tracker itself is always on). No explicit
+    target = observe-only: /healthz reports the burn rates but never
+    gates on them, so upgrading a fleet whose honest latency exceeds
+    the reference target cannot 503 every replica at once."""
+    from ..obs.slo import SloConfig
+
+    if args.slo_commit_p99_ms is None:
+        return SloConfig(enforce=False)
+    return SloConfig(commit_p99_ms=args.slo_commit_p99_ms)
 
 
 def _leakmon_config(args):
@@ -338,7 +391,10 @@ def main(argv=None) -> int:
                               max_wait_ms=args.batch_wait_ms,
                               leakmon=_leakmon_config(args),
                               durability=_durability_config(args),
-                              worker_restart=args.worker_restart)
+                              worker_restart=args.worker_restart,
+                              trace_ring_size=args.trace_ring_size,
+                              slo=_slo_config(args),
+                              profile_enable=args.profile_enable)
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
@@ -374,6 +430,9 @@ def main(argv=None) -> int:
             identity=identity, leakmon=_leakmon_config(args),
             durability=_durability_config(args),
             worker_restart=args.worker_restart,
+            trace_ring_size=args.trace_ring_size,
+            slo=_slo_config(args),
+            profile_enable=args.profile_enable,
         )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None

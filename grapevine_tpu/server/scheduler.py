@@ -61,7 +61,10 @@ class BatchScheduler:
         #: obs.TelemetryRegistry); the scheduler records into the
         #: engine's registry so /metrics serves one merged view
         self.metrics = getattr(engine, "metrics", None)
-        self._queue: list[tuple[QueryRequest, AuthItem | None, Future]] = []
+        #: (request, auth, future, perf_counter enqueue time)
+        self._queue: list[
+            tuple[QueryRequest, AuthItem | None, Future, float]
+        ] = []
         self._inflight: list[Future] = []
         self._last_enqueue = 0.0
         #: monotonic enqueue time of the current queue head — the age of
@@ -98,10 +101,14 @@ class BatchScheduler:
         round's batch; raises AuthFailure (and the op never reaches the
         engine) if it does not verify."""
         fut: Future = Future()
+        # perf_counter enqueue stamp: the SLO's enqueue→settle anchor
+        # (one clock domain with the batcher's round spans); the
+        # scheduler's own deadline math stays on time.monotonic
+        t_enq = time.perf_counter()
         with self._cv:
             if self._closed:
                 raise SchedulerShutdown("scheduler closed")
-            self._queue.append((req, auth, fut))
+            self._queue.append((req, auth, fut, t_enq))
             self._last_enqueue = time.monotonic()
             if len(self._queue) == 1:
                 self._head_enqueue = self._last_enqueue
@@ -146,7 +153,7 @@ class BatchScheduler:
             except BaseException as exc:
                 with self._cv:
                     self._closed = True
-                    stranded = [fut for _, _, fut in self._queue]
+                    stranded = [fut for _, _, fut, _ in self._queue]
                     self._queue.clear()
                     self._cv.notify_all()
                 stranded += self._inflight
@@ -207,6 +214,7 @@ class BatchScheduler:
                     # device executes the previous round (see below), so
                     # it costs no device idle time under load.
                     t_asm0 = time.monotonic()
+                    t_asm0_pc = time.perf_counter()  # tracer clock
                     deadline = t_asm0 + self.max_wait
                     hit_cap = False
                     while len(self._queue) < bs and not self._closed:
@@ -219,19 +227,14 @@ class BatchScheduler:
                             break
                         self._cv.wait(timeout=wait_until - now)
                     chunk, self._queue = self._queue[:bs], self._queue[bs:]
+                    asm_s = time.monotonic() - t_asm0
                     if self._queue:
                         # remaining head has been waiting since roughly
                         # now (it arrived during this window)
                         self._head_enqueue = time.monotonic()
                     if self.metrics is not None:
                         self.metrics.observe_queue_depth(len(self._queue))
-                        asm_s = time.monotonic() - t_asm0
                         self.metrics.observe_phase("assembly", asm_s)
-                        lm = getattr(self.engine, "leakmon", None)
-                        if lm is not None:
-                            # flight-recorder context: the collection
-                            # window that fed the next dispatched round
-                            lm.note_phase("assembly", asm_s)
                         if hit_cap and len(chunk) < bs:
                             # window closed by the max_wait cap, not by
                             # quiescence or a full batch: arrivals are
@@ -242,19 +245,18 @@ class BatchScheduler:
             # the round still in flight on the device plus the chunk just
             # popped off the queue (no longer reachable from _queue)
             self._inflight = ([f for _, f in prev[1]] if prev else []) + [
-                f for _, _, f in chunk
+                f for _, _, f, _ in chunk
             ]
             pending, live = (None, [])
             if chunk:
                 t_v0 = time.monotonic()
+                t_v0_pc = time.perf_counter()
                 if self.metrics is not None:
                     with self.metrics.time_phase("verify"):
                         live = self._verify_chunk(chunk)
                 else:
                     live = self._verify_chunk(chunk)
-                lm = getattr(self.engine, "leakmon", None)
-                if lm is not None:
-                    lm.note_phase("verify", time.monotonic() - t_v0)
+                ver_s = time.monotonic() - t_v0
                 if live:
                     reqs = [r for r, _ in live]
                     try:
@@ -265,6 +267,24 @@ class BatchScheduler:
                             reqs, self.clock()
                         )
                         self._inflight_since = time.monotonic()
+                        # collector-side spans + the oldest op's enqueue
+                        # stamp ride the round handle itself, so the
+                        # tracer/SLO pair them with THIS round even
+                        # while the pipeline overlaps the next window
+                        # (getattr: test fakes return bare objects)
+                        if getattr(pending, "note_span", None) is not None:
+                            pending.note_span("assembly", t_asm0_pc, asm_s)
+                            pending.note_span("verify", t_v0_pc, ver_s)
+                            # anchor on the ops that actually entered
+                            # the round: an auth-rejected op's queue
+                            # wait is not a commit latency, and letting
+                            # it in would hand an attacker (garbage
+                            # signatures are their cheapest input) a
+                            # lever on the SLO burn rate
+                            enq_by_fut = {f: t for _, _, f, t in chunk}
+                            pending.set_enqueued_at(
+                                min(enq_by_fut[f] for _, f in live)
+                            )
                     except Exception as exc:  # pragma: no cover - defensive
                         for _, fut in live:
                             if not fut.done():
@@ -281,7 +301,7 @@ class BatchScheduler:
     def _verify_chunk(self, chunk):
         """Batch signature verification; returns surviving (req, fut)."""
         # --- one multi-scalar multiplication for the round ------------
-        authed = [i for i, (_, a, _) in enumerate(chunk) if a is not None]
+        authed = [i for i, (_, a, _, _) in enumerate(chunk) if a is not None]
         rejected: set[int] = set()
         if authed and not self.scheme.batch_verify(
             [chunk[i][1] for i in authed]
@@ -311,7 +331,7 @@ class BatchScheduler:
             self.metrics.record_auth(failures=len(rejected))
         return [
             (req, fut)
-            for i, (req, _, fut) in enumerate(chunk)
+            for i, (req, _, fut, _) in enumerate(chunk)
             if i not in rejected
         ]
 
@@ -335,7 +355,7 @@ class BatchScheduler:
         with self._cv:
             self._shutdown = True
             self._closed = True
-            undispatched = [fut for _, _, fut in self._queue]
+            undispatched = [fut for _, _, fut, _ in self._queue]
             self._queue.clear()
             self._cv.notify_all()
         for fut in undispatched:

@@ -88,6 +88,9 @@ class GrapevineServer:
         leakmon=None,
         durability=None,
         worker_restart: bool = False,
+        trace_ring_size: int = 512,
+        slo=None,
+        profile_enable: bool = False,
     ):
         self.config = config or GrapevineConfig()
         if scheduler is not None:
@@ -158,6 +161,20 @@ class GrapevineServer:
 
             self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
             self.engine.attach_leakmon(self.leakmon)
+        #: round-trace profiler + commit-latency SLO + optional capture
+        #: gate — one shared attach policy (obs.attach_round_observability
+        #: has the rationale and the observe-only default contract)
+        self.tracer = self.slo = self.profiler = None
+        if self.engine is not None:
+            from ..obs import attach_round_observability
+
+            self.tracer, self.slo, self.profiler = (
+                attach_round_observability(
+                    self.engine, self.metrics_registry,
+                    trace_ring_size=trace_ring_size, slo=slo,
+                    profile_enable=profile_enable,
+                )
+            )
 
     # -- RPC handlers (raw-bytes serializers) ---------------------------
 
@@ -359,6 +376,15 @@ class GrapevineServer:
             v = self.leakmon.last_verdict()
             detail["leakaudit"] = v["verdict"]
             healthy = healthy and v["verdict"] == "PASS"
+        if self.slo is not None:
+            # multi-window burn-rate verdict (obs/slo.py): a breached
+            # commit-latency SLO is a serving fault like any other —
+            # 503 stops routing before the error budget is gone
+            # (OPERATIONS.md §12). O(window) scan over round stamps,
+            # lock-independent of the engine.
+            sv = self.slo.verdict()
+            detail["slo"] = sv
+            healthy = healthy and sv["ok"]
         return healthy, detail
 
     def start_metrics(self, port: int, host: str = "127.0.0.1",
@@ -383,6 +409,10 @@ class GrapevineServer:
             port=port,
             leakaudit=lm.verdict if lm is not None else None,
             flightrec=lm.recorder.dump if lm is not None else None,
+            trace=(self.tracer.chrome_trace if self.tracer is not None
+                   else None),
+            profile=(self.profiler.capture if self.profiler is not None
+                     else None),
         )
         return self._metrics_server.start()
 

@@ -64,7 +64,9 @@ class EngineServer:
 
     def __init__(self, config: GrapevineConfig | None = None, seed: int = 0,
                  max_wait_ms: float | None = None, clock=None, leakmon=None,
-                 durability=None, worker_restart: bool = False):
+                 durability=None, worker_restart: bool = False,
+                 trace_ring_size: int = 512, slo=None,
+                 profile_enable: bool = False):
         from ..engine.batcher import GrapevineEngine
         from ..session import get_signature_scheme
         from .scheduler import BatchScheduler
@@ -84,6 +86,16 @@ class EngineServer:
 
             self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
             self.engine.attach_leakmon(self.leakmon)
+        #: round tracing + commit-latency SLO + optional capture gate —
+        #: one shared attach policy (obs.attach_round_observability has
+        #: the rationale and the observe-only default contract)
+        from ..obs import attach_round_observability
+
+        self.tracer, self.slo, self.profiler = attach_round_observability(
+            self.engine, self.engine.metrics.registry,
+            trace_ring_size=trace_ring_size, slo=slo,
+            profile_enable=profile_enable,
+        )
         kwargs = {} if max_wait_ms is None else {"max_wait_ms": max_wait_ms}
         self.scheduler = BatchScheduler(
             self.engine,
@@ -187,6 +199,11 @@ class EngineServer:
             v = self.leakmon.last_verdict()
             detail["leakaudit"] = v["verdict"]
             healthy = healthy and v["verdict"] == "PASS"
+        # commit-latency SLO burn-rate verdict (obs/slo.py): breached =
+        # stop routing, same as the monolithic server (OPERATIONS.md §12)
+        sv = self.slo.verdict()
+        detail["slo"] = sv
+        healthy = healthy and sv["ok"]
         return healthy, detail
 
     def start_metrics(self, port: int, host: str = "127.0.0.1",
@@ -210,6 +227,9 @@ class EngineServer:
             port=port,
             leakaudit=lm.verdict if lm is not None else None,
             flightrec=lm.recorder.dump if lm is not None else None,
+            trace=self.tracer.chrome_trace,
+            profile=(self.profiler.capture if self.profiler is not None
+                     else None),
         )
         return self._metrics_server.start()
 

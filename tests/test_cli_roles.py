@@ -24,6 +24,11 @@ def _check(argv):
     ["--role", "frontend", "--expiry-period", "60"],
     ["--role", "mono", "--engine", "x:1"],
     ["--role", "mono", "--engine-listen", "127.0.0.1:0"],
+    # observability flags observe the device round: frontend rejects
+    # them even at their default values (ISSUE 6 satellite)
+    ["--role", "frontend", "--trace-ring-size", "512"],
+    ["--role", "frontend", "--slo-commit-p99-ms", "250.0"],
+    ["--role", "frontend", "--profile-enable"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -44,6 +49,12 @@ def test_misapplied_flags_rejected(argv):
      "--metrics-port", "9464"],
     ["--role", "frontend", "--engine", "127.0.0.1:4000",
      "--metrics-port", "0"],
+    # device-owning roles take the tracer/SLO/profiler flags
+    ["--role", "mono", "--trace-ring-size", "1024",
+     "--slo-commit-p99-ms", "100", "--profile-enable"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--trace-ring-size", "64", "--slo-commit-p99-ms", "500.5",
+     "--profile-enable"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

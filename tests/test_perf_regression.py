@@ -1,0 +1,128 @@
+"""The perf-regression sentinel (tools/check_perf_regression.py): the
+tier-1 gate next to check_telemetry_policy / check_checkpoint_seal,
+plus directed units over the comparator.
+
+No bench run happens here — smoke mode is file parsing + dict math, so
+the gate costs milliseconds of the tier-1 budget.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def sentinel():
+    path = os.path.join(REPO, "tools", "check_perf_regression.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_perf_regression", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _line(ops=100.0, p99=50.0, *, config="synth", batch=8, sizes="full",
+          backend="cpu", tag="t"):
+    return {
+        "sizes": sizes, "backend": backend, "pr": tag,
+        "configs": {config: {"ops_per_sec": ops, "p99_round_ms": p99,
+                             "batch": batch, "capacity_log2": 10}},
+    }
+
+
+def test_smoke_gate_passes_on_banked_baseline(sentinel, capsys):
+    """The acceptance criterion: --smoke runs in tier-1 and passes on
+    the repo's banked BENCH_trajectory.jsonl."""
+    assert sentinel.main(["--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "self-test ok" in out and "clean" in out
+
+
+def test_throughput_regression_detected(sentinel):
+    series = sentinel.extract_series([_line(100.0), _line(30.0)])
+    regs, n = sentinel.compare_latest(series, factor=2.0)
+    assert n == 2 and len(regs) == 1 and "ops_per_sec" in regs[0]
+
+
+def test_latency_regression_detected(sentinel):
+    series = sentinel.extract_series([_line(p99=50.0), _line(p99=200.0)])
+    regs, _ = sentinel.compare_latest(series, factor=2.0)
+    assert len(regs) == 1 and "p99_round_ms" in regs[0]
+
+
+def test_within_factor_drift_passes(sentinel):
+    series = sentinel.extract_series(
+        [_line(100.0, 50.0), _line(80.0, 60.0)])
+    regs, n = sentinel.compare_latest(series, factor=2.0)
+    assert n == 2 and regs == []
+
+
+def test_median_banked_value_is_the_baseline(sentinel):
+    """Regression is judged against the MEDIAN of the banked history,
+    not the best-ever value — one lucky-fast historical run must not
+    ratchet the bar toward itself on noisy hardware."""
+    # history [100 (lucky), 40, 42] → median 42; latest 35 is within
+    # 2x of the median even though it is far outside best-ever/2
+    series = sentinel.extract_series(
+        [_line(100.0), _line(40.0), _line(42.0), _line(35.0)])
+    regs, n = sentinel.compare_latest(series, factor=2.0)
+    assert n == 2 and regs == []  # p99 series rides along unchanged
+    # a genuine past-factor collapse against the same history DOES fire
+    series = sentinel.extract_series(
+        [_line(100.0), _line(40.0), _line(42.0), _line(15.0)])
+    regs, _ = sentinel.compare_latest(series, factor=2.0)
+    assert len(regs) == 1 and "ops_per_sec" in regs[0]
+
+
+def test_geometry_and_sizes_partition_series(sentinel):
+    """Toy smoke shapes never gate full-size runs and vice versa; a
+    different batch size is a different series."""
+    for variant in (
+        _line(1.0, 5000.0, sizes="smoke"),
+        _line(1.0, 5000.0, batch=2048),
+        _line(1.0, 5000.0, backend="tpu"),
+    ):
+        series = sentinel.extract_series([_line(100.0, 50.0), variant])
+        regs, n = sentinel.compare_latest(series, factor=2.0)
+        assert n == 0 and regs == []
+
+
+def test_skipped_error_and_nonnumeric_configs_ignored(sentinel):
+    lines = [
+        {"sizes": "full", "backend": "cpu", "configs": {
+            "a": {"skipped": "no wheel"},
+            "b": {"error": "boom"},
+            "c": {"note": "text only", "leakaudit": "PASS"},
+            "d": {"ops_per_sec": 0.0, "batch": 8},  # 0 = unmeasured
+        }},
+    ]
+    assert sentinel.extract_series(lines) == {}
+
+
+def test_fresh_line_compared_against_banked(sentinel):
+    banked = [_line(100.0, 50.0, tag="PR5")]
+    regs, n = sentinel.compare_fresh(_line(20.0, 500.0, tag="new"),
+                                     banked, factor=2.0)
+    assert n == 2 and len(regs) == 2
+    regs, n = sentinel.compare_fresh(_line(95.0, 55.0, tag="new"),
+                                     banked, factor=2.0)
+    assert n == 2 and regs == []
+
+
+def test_selftest_rejects_a_toothless_comparator(sentinel, monkeypatch):
+    """If the comparator silently stops firing, the self-test fails the
+    gate rather than letting a dead sentinel ride along green."""
+    monkeypatch.setattr(sentinel, "compare_latest",
+                        lambda series, factor: ([], 2))
+    with pytest.raises(AssertionError, match="not flagged"):
+        sentinel.selftest(2.0)
+
+
+def test_corrupt_trajectory_fails_loudly(sentinel, tmp_path):
+    bad = tmp_path / "traj.jsonl"
+    bad.write_text('{"ok": 1}\n{not json\n')
+    with pytest.raises(SystemExit, match="unparseable"):
+        sentinel.load_trajectory(str(bad))

@@ -133,6 +133,17 @@ def run_child(args) -> int:
         engine, LeakMonitorConfig(window_rounds=64)
     )
     engine.attach_leakmon(monitor)
+    # the PR-6 observability stack rides every chaos incarnation (as it
+    # does in serving): tracing/SLO must never perturb recovery
+    # bit-equality, and the tracer's schema check runs on real
+    # journal/checkpoint-bearing ledgers here
+    from grapevine_tpu.obs.slo import SloTracker
+    from grapevine_tpu.obs.tracer import RoundTracer
+
+    engine.attach_tracer(
+        RoundTracer(capacity=64, registry=engine.metrics.registry)
+    )
+    engine.attach_slo(SloTracker(registry=engine.metrics.registry))
     events = build_schedule(args.schedule_seed, args.events)
     start = engine.durability.seq  # events[:start] are already durable
     with open(args.progress, "a") as pf:

@@ -165,18 +165,88 @@ def audit_leakmon_registry() -> dict:
         mon.close()
 
 
+def audit_trace_slo_registry() -> dict:
+    """Runtime pass over the round tracer's and SLO tracker's metric
+    namespaces plus the tracer ring schema (ISSUE-6 satellite — the
+    same TelemetryLeakError contract as the flight recorder):
+
+    - the ``grapevine_trace_*`` / ``grapevine_slo_*`` families and the
+      derived ``grapevine_round_bubble_ratio`` gauge exist and carry NO
+      label keys (batch-level scalars only, no dimension to hide an
+      identity in);
+    - the tracer's span-name allowlist is exactly phases + derived
+      windows — nothing outside the canonical PHASES vocabulary;
+    - ``record_round`` rejects a per-op span name and a non-(start,dur)
+      span value with TelemetryLeakError (enforcement has teeth, not
+      just a clean default).
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.engine.metrics import EngineMetrics
+    from grapevine_tpu.obs.phases import PHASES
+    from grapevine_tpu.obs.registry import TelemetryLeakError
+    from grapevine_tpu.obs.slo import SloTracker
+    from grapevine_tpu.obs.tracer import ALLOWED_SPAN_NAMES, RoundTracer
+
+    em = EngineMetrics()
+    tracer = RoundTracer(capacity=8, registry=em.registry)
+    SloTracker(registry=em.registry)
+    report = em.registry.audit()  # raises on any violation
+
+    families = [
+        m for m in em.registry.collect()
+        if m.name.startswith(("grapevine_trace_", "grapevine_slo_"))
+        or m.name == "grapevine_round_bubble_ratio"
+    ]
+    if len(families) < 3:
+        raise SystemExit(
+            "trace/slo namespace missing: RoundTracer/SloTracker "
+            f"registered only {[m.name for m in families]}"
+        )
+    for m in families:
+        if m.label_keys:
+            raise SystemExit(
+                f"trace/slo metric {m.name!r} carries label keys "
+                f"{list(m.label_keys)} — these series are batch-level "
+                "scalars with no dimensions by design"
+            )
+
+    stray = ALLOWED_SPAN_NAMES - set(PHASES) - {"device", "round"}
+    if stray:
+        raise SystemExit(
+            f"tracer span allowlist drifted outside the phase "
+            f"vocabulary: {sorted(stray)}"
+        )
+    for bad_ledger, why in (
+        ({"op_read": (0.0, 1.0)}, "per-op span name"),
+        ({"evict": "not-a-span"}, "non-(start,dur) span value"),
+        ({"evict": (0.0, -1.0)}, "negative duration"),
+    ):
+        try:
+            tracer.record_round(bad_ledger)
+        except TelemetryLeakError:
+            continue
+        raise SystemExit(
+            f"tracer ring schema has no teeth: {why} was accepted"
+        )
+    report["trace_slo_families"] = len(families)
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
         print(f"TELEMETRY POLICY VIOLATION: {v}", file=sys.stderr)
     report = audit_shipped_registry()
     lm_report = audit_leakmon_registry()
+    ts_report = audit_trace_slo_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
         f"({report['metrics']} metrics, {report['series']} series); "
         f"leakmon audit ok ({lm_report['leakmon_families']} families, "
-        f"{lm_report['series']} series incl. engine)"
+        f"{lm_report['series']} series incl. engine); trace/slo audit "
+        f"ok ({ts_report['trace_slo_families']} families, ring schema "
+        "enforced)"
     )
     return 1 if violations else 0
 

@@ -24,6 +24,13 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
                  schedule being tested, not scale)
 6. trace       — jax.profiler trace of the headline round, to reconcile
                  PERF.md's ~5-10 ms model
+6b. live_profile — the PR-6 runtime capture path on a real chip: an
+                 engine serving through the scheduler with the round
+                 tracer + SLO stack on, profiled via the same
+                 ProfilerGate /profile?ms=N exposes — proves a live
+                 deployment can be profiled without restart, and banks
+                 the first device bubble ratio (the number that sizes
+                 the pipelined-round refactor, ROADMAP item 2)
 7. fullbench   — bench.py end to end on the live backend (full pass
                  only): the driver-format artifact as a dress
                  rehearsal, and it warms the shared compilation cache
@@ -419,6 +426,105 @@ def stage_trace(cap, args):
              median_round_ms=round(float(np.median(times)) * 1e3, 3))
 
 
+def stage_live_profile(cap, args):
+    """PR-6 observability on a live engine, device edition: serve
+    rounds through the BatchScheduler with the tracer + SLO attached,
+    trigger a ProfilerGate capture mid-traffic (the exact callable
+    /profile?ms=N runs), and report the device bubble ratio — host
+    phase timers cannot see inside the fused round program, so this
+    ratio measured ON TPU is the first real evidence for sizing the
+    double-buffered round pipeline (ROADMAP item 2 / Palermo)."""
+    import threading
+
+    import numpy as np
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.obs.profiler import ProfilerGate
+    from grapevine_tpu.obs.slo import SloTracker
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cl, b = (14, 16) if args.quick else (18, 256)
+    cfg = GrapevineConfig(max_messages=1 << cl, max_recipients=1 << 10,
+                          batch_size=b)
+    engine = GrapevineEngine(cfg)
+    tracer = RoundTracer(capacity=256, registry=engine.metrics.registry)
+    engine.attach_tracer(tracer)
+    slo = SloTracker(registry=engine.metrics.registry)
+    engine.attach_slo(slo)
+    sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+    gate = ProfilerGate(outdir=os.path.join(_REPO, "tpu_live_profile"))
+    stop = threading.Event()
+    errs: list = []
+
+    def traffic(j):
+        me = bytes([j + 1]) * 32
+        i = 0
+        try:
+            while not stop.is_set():
+                # recipients rotate a wide pool: ms-scale TPU rounds
+                # commit thousands of CREATEs over the capture window,
+                # and a fixed recipient would hit the 62-message
+                # mailbox cap mid-stage (the bench slo_loopback lesson)
+                rcp = bytes([j + 2, (i % 251) + 1,
+                             (i // 251) % 251]) + bytes(29)
+                r = sched.submit(QueryRequest(
+                    request_type=C.REQUEST_TYPE_CREATE, auth_identity=me,
+                    auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                    record=RequestRecord(
+                        msg_id=C.ZERO_MSG_ID, recipient=rcp,
+                        payload=bytes([i & 0xFF]) * C.PAYLOAD_SIZE)))
+                assert r.status_code == C.STATUS_CODE_SUCCESS, r.status_code
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=traffic, args=(j,), daemon=True)
+               for j in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(3.0)  # warm: compile + settle into steady state
+        result = gate.capture(ms=2000)  # the /profile?ms=2000 path
+        time.sleep(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        sched.close()
+    if errs:
+        raise errs[0]
+    n_files = sum(len(fs) for _, _, fs in os.walk(result["trace_dir"]))
+    if n_files == 0:
+        raise RuntimeError("profiler capture wrote no trace files")
+    trace = tracer.chrome_trace()
+    v = slo.verdict()
+    # "device" spans end when the HOST observed readiness (resolve runs
+    # after the next round's collection window under the pipelined
+    # scheduler), so their duration is an upper bound on device-busy
+    # time, not device time itself. The decision numbers are the bubble
+    # ratio (host-blocked fraction, measured exactly) and the evict
+    # wait (a lower bound on the device tail the host actually paid);
+    # pure unpipelined device time comes from the trace/headline stages.
+    dev = [e["dur"] for e in trace["traceEvents"]
+           if e.get("name") == "grapevine/device"]
+    ev = [e["dur"] for e in trace["traceEvents"]
+          if e.get("name") == "grapevine/evict"]
+    cap.emit("live_profile", capacity_log2=cl, batch=b,
+             trace_dir=result["trace_dir"], trace_files=n_files,
+             capture_ms=result["ms"],
+             rounds_traced=trace["otherData"]["rounds_recorded_total"],
+             bubble_ratio=trace["otherData"]["bubble_ratio"],
+             median_device_window_ms=round(float(np.median(dev)) / 1e3, 3)
+             if dev else None,
+             median_evict_wait_ms=round(float(np.median(ev)) / 1e3, 3)
+             if ev else None,
+             slo_ok=v["ok"], slo_fast_burn=v["fast_burn_rate"])
+
+
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
@@ -428,6 +534,9 @@ STAGES = [
     # program (shared cache), so it is nearly free — and the first
     # window proved windows can close in minutes
     ("trace", stage_trace, 900),
+    # live_profile right after trace: same geometry family, proves the
+    # runtime /profile path and banks the device bubble ratio cheaply
+    ("live_profile", stage_live_profile, 900),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
