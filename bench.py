@@ -16,6 +16,10 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
   4c. sort_ab            xla vs radix bounded-key sort engine A/B —
                          eviction/dedup machinery + whole-round
                          B-sweep, interleaved (PR5; PERF.md Round 7)
+  4d. posmap_ab          flat vs recursive position map A/B — lookup
+                         machinery (B × capacity grid, with the
+                         private/HBM memory split) + whole-round
+                         B-sweep, interleaved (PR7; PERF.md Round 9)
   5. sharded             bucket-tree sharded over a device mesh (CPU
                          mesh subprocess when one chip is visible)
   6. server_loopback     full-stack gRPC: session crypto + batched
@@ -52,7 +56,7 @@ def _p99(times_s: list[float]) -> float:
 
 def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp",
                vphases_impl=None, cipher_rounds=8, mailbox_cap=None,
-               sort_impl=None):
+               sort_impl=None, posmap_impl=None):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
@@ -70,6 +74,7 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="j
         bucket_cipher_rounds=cipher_rounds,
         vphases_impl=vphases_impl,
         sort_impl=sort_impl,
+        posmap_impl=posmap_impl,
         **extra,
     )
     ecfg = EngineConfig.from_config(cfg)
@@ -497,6 +502,24 @@ def _vphases_machinery_sweep(smoke):
     return res
 
 
+def _min_of(fn, args, reps):
+    """Interleaved-A/B timing primitive shared by the `_ab` configs:
+    min of ``reps`` timed calls after one compile+warm call — the min
+    is the unbiased cost of a shape-static oblivious program under this
+    sandbox's 2-vCPU scheduler noise (PERF.md Round 6 methodology)."""
+    import time as _time
+
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(_time.perf_counter() - t0)
+    return float(np.min(ts))
+
+
 def bench_sort_ab(smoke):
     """Config 4c: xla vs radix bounded-key sort engine A/B (PR5).
 
@@ -530,15 +553,6 @@ def bench_sort_ab(smoke):
 
     from grapevine_tpu.oblivious.radix import radix_group_sort, radix_rank
     from grapevine_tpu.oblivious.segmented import multiword_group_sort
-
-    def _min_of(fn, args, reps):
-        jax.block_until_ready(fn(*args))  # compile + warm
-        ts = []
-        for _ in range(reps):
-            t0 = _time.perf_counter()
-            jax.block_until_ready(fn(*args))
-            ts.append(_time.perf_counter() - t0)
-        return float(np.min(ts))
 
     reps = 3 if smoke else 7
     out = {"machinery": {}, "sweep": {}}
@@ -639,6 +653,165 @@ def bench_sort_ab(smoke):
                 float(np.median(times["radix"])) * 1e3, 2
             ),
             "speedup_radix_over_xla": round(mx / mr, 3),
+        }
+    return out
+
+
+def bench_posmap_ab(smoke):
+    """Config 4d: flat vs recursive position map A/B (PR7).
+
+    Two scopes, both interleaved min-of-N (the vphases/sort_ab
+    methodology):
+
+    - **machinery**: ``lookup_remap_round`` isolated — the exact code
+      the knob swaps — over a (batch B × capacity) grid: flat is one
+      private gather + scatter, recursive is a full internal-ORAM round
+      over blocks/k blocks of k entries. This is the *cost of position
+      resolution itself*, the number OPERATIONS.md §13's "when to flip"
+      guidance prices against the capacity win.
+    - **whole round**: B-sweep with ``posmap_impl`` as the only knob —
+      what a serving round actually pays, since the recursive map adds
+      its internal path fetch/evict to every ORAM round.
+
+    Honest-reporting note (the PR-3/PR-5 lesson): the recursive map is
+    NOT a speed optimization and is not expected to win wall-clock
+    anywhere — it buys ~sqrt(capacity)× less *resident* position memory
+    (the ≥2^30 capacity enabler) for extra HBM traffic. The A/B exists
+    to price that overhead honestly; auto stays "flat" until capacity
+    forces the flip or the capture's ``posmap_perf`` stage (real chip)
+    shows the overhead is hidden under the round's existing
+    gather/scatter wall. Override sweeps with GRAPEVINE_POSMAP_AB_BS /
+    GRAPEVINE_POSMAP_AB_CAPS."""
+    import os
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from grapevine_tpu.oram.path_oram import OramConfig, init_oram
+    from grapevine_tpu.oram.posmap import (
+        derive_posmap_spec,
+        lookup_remap_round,
+        posmap_hbm_bytes,
+        posmap_private_bytes,
+    )
+    from grapevine_tpu.oram.round import occurrence_masks
+
+    reps = 3 if smoke else 7
+    out = {"machinery": {}, "sweep": {}}
+
+    # --- machinery: the lookup round isolated, B × capacity grid -------
+    caps = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_POSMAP_AB_CAPS",
+            "4096,65536" if smoke else "65536,1048576",
+        ).split(",")
+    ]
+    bs_m = (64, 256) if smoke else (256, 1024)
+    rng = np.random.default_rng(5)
+    for cap_n in caps:
+        height = max(1, cap_n.bit_length() - 2)  # density-2 payload shape
+        flat_cfg = OramConfig(height=height, value_words=4, n_blocks=cap_n)
+        spec = derive_posmap_spec(cap_n)
+        rec_cfg = OramConfig(
+            height=height, value_words=4, n_blocks=cap_n, posmap=spec
+        )
+        pm_f = init_oram(flat_cfg, jax.random.PRNGKey(1)).posmap
+        pm_r = init_oram(rec_cfg, jax.random.PRNGKey(1)).posmap
+        for b in bs_m:
+            idxs = jnp.asarray(
+                rng.integers(0, cap_n + 1, b).astype(np.uint32)
+            )
+            nl = jnp.asarray(rng.integers(0, flat_cfg.leaves, b).astype(np.uint32))
+            dl = jnp.asarray(rng.integers(0, flat_cfg.leaves, b).astype(np.uint32))
+            pnl = jnp.asarray(
+                rng.integers(0, spec.inner_leaves, b).astype(np.uint32)
+            )
+            pdl = jnp.asarray(
+                rng.integers(0, spec.inner_leaves, b).astype(np.uint32)
+            )
+
+            def lookup(cfg, pm, pm_nl, pm_dl):
+                fo, lo, _ = occurrence_masks(idxs, cfg.dummy_index)
+                pm2, leaves, inner = lookup_remap_round(
+                    cfg, pm, idxs, nl, dl, fo, lo,
+                    pm_new_leaves=pm_nl, pm_dummy_leaves=pm_dl,
+                )
+                # pm2 must be a live output: dropping it lets XLA
+                # dead-code-eliminate flat's remap scatter and the
+                # internal round's whole eviction write-back (the
+                # sort_ab full-output rule)
+                return (pm2, leaves) if inner is None else (pm2, leaves, inner)
+
+            tf = _min_of(
+                jax.jit(lambda pm: lookup(flat_cfg, pm, None, None)),
+                (pm_f,), reps,
+            )
+            tr = _min_of(
+                jax.jit(lambda pm: lookup(rec_cfg, pm, pnl, pdl)),
+                (pm_r,), reps,
+            )
+            out["machinery"][f"lookup_cap{cap_n}_b{b}"] = {
+                "k": spec.entries_per_block,
+                "flat_ms": round(tf * 1e3, 3),
+                "recursive_ms": round(tr * 1e3, 3),
+                "overhead_recursive_over_flat": round(tr / tf, 2),
+                "flat_private_mib": round(
+                    posmap_private_bytes(flat_cfg) / 2**20, 3
+                ),
+                "recursive_private_mib": round(
+                    posmap_private_bytes(rec_cfg) / 2**20, 3
+                ),
+                "recursive_hbm_mib": round(
+                    posmap_hbm_bytes(rec_cfg) / 2**20, 3
+                ),
+            }
+
+    # --- whole round: posmap_impl the only knob ------------------------
+    sweep = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_POSMAP_AB_BS", "16,64" if smoke else "64,256,1024"
+        ).split(",")
+    ]
+    n_timed = 3 if smoke else 9
+    for B in sweep:
+        ctxs = {}
+        for impl in ("flat", "recursive"):
+            cfg, ecfg, state, step = _mk_engine(
+                1 << 12, 1 << 9, B, posmap_impl=impl,
+                cipher_rounds=0, mailbox_cap=8,
+            )
+            batches = make_batches(3, B, seed=13)
+            state, resp, _ = step(ecfg, state, batches[0])
+            jax.block_until_ready(resp)
+            ctxs[impl] = [ecfg, state, step, batches]
+
+        def one_round(ctx, i):
+            ecfg, state, step, batches = ctx
+            t0 = _time.perf_counter()
+            state, resp, _ = step(ecfg, state, batches[i % 3])
+            jax.block_until_ready(resp)
+            ctx[1] = state
+            return _time.perf_counter() - t0
+
+        times = {"flat": [], "recursive": []}
+        for i in range(n_timed):  # interleaved A/B
+            times["flat"].append(one_round(ctxs["flat"], i))
+            times["recursive"].append(one_round(ctxs["recursive"], i))
+        mf = float(np.min(times["flat"]))
+        mr = float(np.min(times["recursive"]))
+        out["sweep"][str(B)] = {
+            "flat_round_ms": round(mf * 1e3, 2),
+            "recursive_round_ms": round(mr * 1e3, 2),
+            "median_flat_round_ms": round(
+                float(np.median(times["flat"])) * 1e3, 2
+            ),
+            "median_recursive_round_ms": round(
+                float(np.median(times["recursive"])) * 1e3, 2
+            ),
+            "overhead_recursive_over_flat": round(mr / mf, 3),
         }
     return out
 
@@ -1055,6 +1228,7 @@ CONFIGS = [
     ("crd_loop", bench_crd_loop),
     ("vphases_ab", bench_vphases_ab),
     ("sort_ab", bench_sort_ab),
+    ("posmap_ab", bench_posmap_ab),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),

@@ -140,6 +140,17 @@ class GrapevineConfig:
                 "commit='op' (the differential-oracle engine) supports "
                 "only mailbox_choices=1"
             )
+        if self.posmap_impl not in (None, "flat", "recursive"):
+            raise ValueError(
+                f"posmap_impl must be None, 'flat' or 'recursive', got "
+                f"{self.posmap_impl!r}"
+            )
+        if self.commit == "op" and self.posmap_impl == "recursive":
+            raise ValueError(
+                "commit='op' (the differential-oracle engine) supports "
+                "only posmap_impl='flat' — the recursive position map "
+                "rides the phase-major batched round"
+            )
     #: slot-order semantics implementation for the phase-major engine's
     #: vectorized phases (engine/vphases.py): "dense" = [B,B] masked
     #: matrices + one-hot bool-matmuls (MXU-shaped; O(B²) compute and
@@ -177,6 +188,29 @@ class GrapevineConfig:
     #: the O(n log² n) bitonic side — the default flips only on the
     #: capture's ``sort_perf`` device A/B (the vphases_impl playbook).
     sort_impl: str | None = None
+
+    #: position-map implementation for both ORAMs (oram/posmap.py):
+    #: "flat" = the private u32[blocks+1] table in working memory —
+    #: bit-for-bit the pre-PR-7 engine; "recursive" = the classic
+    #: recursive construction (Path ORAM §"recursive construction",
+    #: arXiv:1202.5150) one level deep — k ≈ sqrt(blocks) position
+    #: entries packed per block of a smaller internal Path ORAM whose
+    #: bucket tree lives in encrypted, shardable HBM, leaving only a
+    #: blocks/k-entry table resident (the ≥2^30-record capacity path,
+    #: ROADMAP item 5; geometry auto-derived from capacity, sizing
+    #: table in OPERATIONS.md §13). Bit-identical responses and final
+    #: payload-tree state either way (tests/test_posmap_ab.py); each
+    #: outer round resolves ALL B positions through exactly B internal
+    #: accesses, so the transcript's access count stays data-
+    #: independent (CI-audited, tools/check_posmap_oblivious.py).
+    #: None = auto: currently "flat" on every backend — the recursive
+    #: map pays ~2× HBM path traffic per round for a ~k× smaller
+    #: resident footprint, a trade that only *wins* once capacity
+    #: exceeds private memory; flip per capacity (OPERATIONS.md §13)
+    #: or after tools/tpu_capture.py's ``posmap_perf`` stage prices it
+    #: on a real chip (the vphases/sort playbook). Requires
+    #: commit="phase" and power-of-two block spaces >= 8 on both trees.
+    posmap_impl: str | None = None
 
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient

@@ -401,6 +401,97 @@ class GrapevineEngine:
         self.metrics.observe_phase("sort", best)
         return best
 
+    def calibrate_posmap_phase(self, reps: int = 5) -> float:
+        """Measure the round's position-resolution workload standalone
+        and record it under the ``posmap`` phase (obs/phases.py).
+
+        Same calibration stance as ``calibrate_sort_phase``: the host
+        cannot time inside the fused round program, but position
+        resolution is shape-static and data-independent (that is the
+        whole obliviousness claim — tools/check_posmap_oblivious.py), so
+        a standalone jitted run of the SAME ``lookup_remap_round``
+        machinery at the round's exact geometry — all three ORAM rounds'
+        batch lookups (mailbox A, records B, mailbox C) — IS the
+        per-round position-handling cost. Under ``posmap_impl="flat"``
+        that is one private gather + scatter per round; under
+        ``"recursive"`` it is the internal ORAM's full rounds, which is
+        exactly the number /trace needs to attribute separately from
+        ``oram_evict``. One small jit compile at serving startup, zero
+        hot-path cost; min-of-``reps`` seconds returned.
+        """
+        import time as _time
+
+        from ..oram.posmap import init_posmap, lookup_remap_round
+        from ..oram.round import occurrence_masks, occurrence_masks_sorted
+
+        ecfg = self.ecfg
+        b, d = ecfg.batch_size, ecfg.mb_choices
+        jobs = [(ecfg.mb, b * d), (ecfg.rec, b), (ecfg.mb, b * d)]
+        occ, simpl = ecfg.vphases_impl, ecfg.sort_impl
+
+        # fresh per-tree posmap pytrees at the engine's geometry: the
+        # cost is data-independent, so a fresh state prices the live one
+        # without touching device state under the lock. init_posmap, not
+        # init_oram — materializing full payload-scale trees just to
+        # read .posmap would transiently double tree memory at startup
+        pms = [
+            init_posmap(cfg, jax.random.PRNGKey(17 + i))
+            for i, (cfg, _) in enumerate(jobs)
+        ]
+
+        def workload(key, pms):
+            outs = []
+            ks = jax.random.split(key, 4 * len(jobs))
+            for i, (cfg, nb) in enumerate(jobs):
+                u32 = jnp.uint32
+                idxs = jax.random.bits(ks[4 * i], (nb,), u32) % u32(
+                    cfg.blocks + 1
+                )
+                nl = jax.random.bits(ks[4 * i + 1], (nb,), u32) & u32(
+                    cfg.leaves - 1
+                )
+                dl = jax.random.bits(ks[4 * i + 2], (nb,), u32) & u32(
+                    cfg.leaves - 1
+                )
+                if occ == "scan":
+                    fo, lo, _ = occurrence_masks_sorted(
+                        idxs, cfg.dummy_index, sort_impl=simpl,
+                        key_bits=max(1, cfg.dummy_index.bit_length()),
+                    )
+                else:
+                    fo, lo, _ = occurrence_masks(idxs, cfg.dummy_index)
+                pm_nl = pm_dl = None
+                if cfg.posmap is not None:
+                    il = cfg.posmap.inner_leaves
+                    pm_bits = jax.random.bits(ks[4 * i + 3], (2, nb), u32)
+                    pm_nl = pm_bits[0] & u32(il - 1)
+                    pm_dl = pm_bits[1] & u32(il - 1)
+                pm2, leaves, inner = lookup_remap_round(
+                    cfg, pms[i], idxs, nl, dl, fo, lo,
+                    pm_new_leaves=pm_nl, pm_dummy_leaves=pm_dl,
+                    occ_impl=occ, sort_impl=simpl,
+                )
+                # the updated map must be a live output — an unused pm2
+                # lets XLA dead-code-eliminate the remap scatter (flat)
+                # / the internal round's eviction write-back (recursive)
+                # and the phase gauge would undercount
+                outs.append((pm2, leaves))
+                if inner is not None:
+                    outs.append(inner)
+            return outs
+
+        fn = jax.jit(workload)
+        key = jax.random.PRNGKey(0)
+        jax.block_until_ready(fn(key, pms))  # compile + warm
+        best = None
+        for _ in range(max(1, reps)):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(key, pms))
+            dt = _time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        self.metrics.observe_phase("posmap", best)
+        return best
+
     def handle_queries(
         self, reqs: list[QueryRequest], now: int
     ) -> list[QueryResponse]:
@@ -556,7 +647,14 @@ class GrapevineEngine:
 
         with self._lock:
             state = self.state
-            for tree in (state.rec, state.mb):
+            trees = [state.rec, state.mb]
+            if self.ecfg.rec.posmap is not None:
+                # recursive position maps (oram/posmap.py) carry their
+                # own internal ORAM whose stash fills under the same
+                # pressure — invisible here would mean silent position
+                # loss with a green gauge
+                trees += [state.rec.posmap.inner, state.mb.posmap.inner]
+            for tree in trees:
                 self.metrics.observe_stash(int(stash_occupancy(tree)))
 
     def health(self) -> dict:
@@ -564,9 +662,16 @@ class GrapevineEngine:
         self.sample_stash()
         with self._lock:
             state = self.state  # one round's state for a consistent snapshot
+            overflow = int(state.rec.overflow) + int(state.mb.overflow)
+            if self.ecfg.rec.posmap is not None:
+                # internal position-ORAM overflow loses k position
+                # entries per dropped block — every bit as unhealthy as
+                # payload stash loss
+                overflow += int(state.rec.posmap.inner.overflow)
+                overflow += int(state.mb.posmap.inner.overflow)
             return {
                 "messages": self.ecfg.max_messages - int(state.free_top),
                 "recipients": int(state.recipients),
-                "stash_overflow": int(state.rec.overflow) + int(state.mb.overflow),
+                "stash_overflow": overflow,
                 **self.metrics.snapshot(),
             }

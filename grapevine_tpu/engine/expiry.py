@@ -69,7 +69,15 @@ def _chunked_tree_sweep(cfg: OramConfig, oram: OramState, carry0, body):
     """Run ``body(carry, (plaintext idx [rpc, Z], plaintext val
     [rpc, Z*V])) -> (carry, (idx', val'))`` over the whole tree in
     chunks, with per-chunk decrypt/re-encrypt when the cipher is on.
-    Returns (carry, OramState with new tree + nonces/epoch advanced)."""
+    Returns (carry, OramState with new tree + nonces/epoch advanced).
+
+    A recursive position map (cfg.posmap set, oram/posmap.py) adds the
+    per-slot leaf-metadata plane, encrypted under the same per-bucket
+    nonces as the idx/val rows: the sweep re-keys every nonce, so the
+    plane must be decrypt/re-encrypted in the same pass — its values
+    never change (expiry only kills blocks; dead slots are masked by
+    the SENTINEL idx), but its ciphertext epoch must follow the bucket.
+    """
     z, v = cfg.bucket_slots, cfg.value_words
     n = cfg.n_buckets_padded
     rpc = _chunk_rows(cfg)
@@ -78,9 +86,15 @@ def _chunked_tree_sweep(cfg: OramConfig, oram: OramState, carry0, body):
     idx3 = oram.tree_idx.reshape(nch, rpc, z)
     val3 = oram.tree_val.reshape(nch, rpc, z * v)
     eps = oram.nonces.reshape(nch, rpc, 2)
+    recrypt_leaf = cfg.posmap is not None and cfg.encrypted
+    leaf3 = (
+        oram.tree_leaf.reshape(nch, rpc, z)
+        if recrypt_leaf
+        else jnp.zeros((nch, rpc, 0), U32)
+    )
 
     def scan_body(carry, xs):
-        bid, ix, vl, ep = xs
+        bid, ix, vl, ep, lf = xs
         if cfg.encrypted:
             ks = row_keystream(
                 oram.cipher_key, bid, ep, cfg.row_words, cfg.cipher_rounds
@@ -95,14 +109,27 @@ def _chunked_tree_sweep(cfg: OramConfig, oram: OramState, carry0, body):
             )
             ix = ix ^ ks[:, :z]
             vl = vl ^ ks[:, z:]
-        return carry, (ix, vl)
+            if recrypt_leaf:
+                # leaf-plane stream: same (bucket, epoch), bucket word
+                # offset by n_buckets_padded (path_oram.leaf_plane_cipher
+                # domain separation)
+                boff = bid + U32(cfg.n_buckets_padded)
+                lf = lf ^ row_keystream(
+                    oram.cipher_key, boff, ep, z, cfg.cipher_rounds
+                )
+                lf = lf ^ row_keystream(
+                    oram.cipher_key, boff, epn, z, cfg.cipher_rounds
+                )
+        return carry, (ix, vl, lf)
 
-    carry, (idx_o, val_o) = jax.lax.scan(
-        scan_body, carry0, (bids, idx3, val3, eps)
+    carry, (idx_o, val_o, leaf_o) = jax.lax.scan(
+        scan_body, carry0, (bids, idx3, val3, eps, leaf3)
     )
     new = oram._replace(
         tree_idx=idx_o.reshape(-1), tree_val=val_o.reshape(n, z * v)
     )
+    if recrypt_leaf:
+        new = new._replace(tree_leaf=leaf_o.reshape(-1))
     if cfg.encrypted:
         new = new._replace(
             nonces=jnp.broadcast_to(oram.epoch[None, :], oram.nonces.shape),

@@ -154,6 +154,25 @@ def engine_round_step(
     )
     id_rand = jax.random.bits(keys[6], (b, 3), U32)
 
+    # recursive position map (oram/posmap.py): each round additionally
+    # needs fresh uniform *internal* leaves — drawn from a fold_in side
+    # stream so the flat engine's draws above are untouched bit-for-bit
+    # (the flat↔recursive response/state identity contract)
+    recursive = ecfg.rec.posmap is not None
+    pm = {"a": (None, None), "b": (None, None), "c": (None, None)}
+    if recursive:
+        mb_il = ecfg.mb.posmap.inner_leaves
+        rec_il = ecfg.rec.posmap.inner_leaves
+        kpm = jax.random.split(jax.random.fold_in(state.rng, 0x504D), 6)
+        pm = {
+            "a": (jax.random.bits(kpm[0], (b * d,), U32) & U32(mb_il - 1),
+                  jax.random.bits(kpm[1], (b * d,), U32) & U32(mb_il - 1)),
+            "b": (jax.random.bits(kpm[2], (b,), U32) & U32(rec_il - 1),
+                  jax.random.bits(kpm[3], (b,), U32) & U32(rec_il - 1)),
+            "c": (jax.random.bits(kpm[4], (b * d,), U32) & U32(mb_il - 1),
+                  jax.random.bits(kpm[5], (b * d,), U32) & U32(mb_il - 1)),
+        }
+
     is_create = rt == C.REQUEST_TYPE_CREATE
     is_read = rt == C.REQUEST_TYPE_READ
     is_update = rt == C.REQUEST_TYPE_UPDATE
@@ -216,6 +235,7 @@ def engine_round_step(
             ecfg.mb, state.mb, idxs_mb_flat, nl_a, dl_a,
             phase_a_batch(ecfg, ctx), axis_name,
             occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
+            pm_new_leaves=pm["a"][0], pm_dummy_leaves=pm["a"][1],
         )
     free_top = state.free_top - out_a["n_allocs"]
     recipients = state.recipients + out_a["n_claims"]
@@ -251,6 +271,7 @@ def engine_round_step(
             ecfg.rec, state.rec, idx_b, nl_b, dl_b,
             phase_b_batch(ecfg, ctx_b), axis_name,
             occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
+            pm_new_leaves=pm["b"][0], pm_dummy_leaves=pm["b"][1],
         )
 
     # freed blocks return to the freelist in slot order — one vectorized
@@ -274,6 +295,7 @@ def engine_round_step(
             ecfg.mb, mb1, idxs_mb_flat, nl_c, dl_c,
             phase_c_batch(ecfg, ctx_c), axis_name,
             occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
+            pm_new_leaves=pm["c"][0], pm_dummy_leaves=pm["c"][1],
         )
 
     # ---- response assembly (shared with the op-major engine) ----------
@@ -294,10 +316,26 @@ def engine_round_step(
     )
     # transcript: D leaves per mailbox round + 1 records leaf per op —
     # [B, 2D+1] columns (a_0..a_{D-1}, b, c_0..c_{D-1}); every entry an
-    # independent uniform draw either way
-    transcripts = jnp.concatenate(
-        [leaf_a.reshape(b, d), leaf_b[:, None], leaf_c.reshape(b, d)], axis=1
-    )
+    # independent uniform draw either way. Recursive posmap: the
+    # internal ORAM's accesses are public transcript too — the same
+    # layout is appended as columns [2D+1, 2(2D+1)) so the leak monitor
+    # audits the position-resolution traffic alongside the payload's
+    # (obs/leakmon.py mb_pm/rec_pm streams)
+    if recursive:
+        transcripts = jnp.concatenate(
+            [
+                leaf_a[:, 0].reshape(b, d), leaf_b[:, 0:1],
+                leaf_c[:, 0].reshape(b, d),
+                leaf_a[:, 1].reshape(b, d), leaf_b[:, 1:2],
+                leaf_c[:, 1].reshape(b, d),
+            ],
+            axis=1,
+        )
+    else:
+        transcripts = jnp.concatenate(
+            [leaf_a.reshape(b, d), leaf_b[:, None], leaf_c.reshape(b, d)],
+            axis=1,
+        )
 
     new_state = EngineState(
         rec=rec1,

@@ -81,6 +81,10 @@ class EngineConfig:
     #: bounded-key sort engine (oblivious/radix.py): "xla" comparison
     #: sorts or "radix" counting passes — bit-identical permutations
     sort_impl: str = "xla"
+    #: resolved position-map implementation (oram/posmap.py): "flat" or
+    #: "recursive" — the per-tree geometry lives in rec.posmap/mb.posmap
+    #: (PosMapSpec), which the checkpoint fingerprint covers via repr
+    posmap_impl: str = "flat"
 
     @property
     def id_bits(self) -> int:
@@ -117,6 +121,26 @@ class EngineConfig:
                 # `sort_perf` A/B on a real chip (the vphases_impl
                 # playbook).
                 simpl = "xla"
+        # position-map impl: auto resolves to "flat" on every backend —
+        # the recursive map trades ~2× HBM path traffic per round for a
+        # ~sqrt(blocks)× smaller resident footprint, a win only once
+        # capacity outgrows private memory (flip per OPERATIONS.md §13
+        # or after tools/tpu_capture.py posmap_perf prices it on-chip)
+        pimpl = cfg.posmap_impl if cfg.posmap_impl is not None else "flat"
+        rec_pm = mb_pm = None
+        if pimpl == "recursive":
+            from ..oram.posmap import derive_posmap_spec
+
+            rec_pm = derive_posmap_spec(
+                cfg.max_messages,
+                stash_size=cfg.stash_size,
+                cipher_rounds=cfg.bucket_cipher_rounds,
+            )
+            mb_pm = derive_posmap_spec(
+                m,
+                stash_size=cfg.stash_size,
+                cipher_rounds=cfg.bucket_cipher_rounds,
+            )
         return cls(
             max_messages=cfg.max_messages,
             max_recipients=cfg.max_recipients,
@@ -131,6 +155,7 @@ class EngineConfig:
                 cipher_rounds=cfg.bucket_cipher_rounds,
                 cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=cfg.max_messages,
+                posmap=rec_pm,
             ),
             mb=OramConfig(
                 height=cfg.mailbox_height,
@@ -140,12 +165,14 @@ class EngineConfig:
                 cipher_rounds=cfg.bucket_cipher_rounds,
                 cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=m,
+                posmap=mb_pm,
             ),
             mb_table_buckets=m,
             mb_slots=k,
             mb_choices=cfg.resolved_mailbox_choices,
             vphases_impl=vimpl,
             sort_impl=simpl,
+            posmap_impl=pimpl,
         )
 
 

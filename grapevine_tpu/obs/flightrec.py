@@ -49,7 +49,10 @@ ALLOWED_FIELDS = frozenset({
 
 ALLOWED_PHASE_KEYS = frozenset(PHASES) | {"round"}
 
-ALLOWED_TREES = frozenset({"rec", "mb"})
+#: detector streams: the two payload trees plus — under a recursive
+#: position map (oram/posmap.py) — their internal position-ORAM streams.
+#: All four are windowed batch-level statistics, never per-op.
+ALLOWED_TREES = frozenset({"rec", "mb", "rec_pm", "mb_pm"})
 
 ALLOWED_STAT_KEYS = frozenset({
     "collision_rate", "collision_pairs",

@@ -203,6 +203,11 @@ class TranscriptLeakMonitor:
                 "pooled transcript leaves in the current window "
                 "(uniformity detector sample size)", labels=labels)
 
+    @property
+    def streams(self) -> tuple:
+        """Declared stream names (e.g. ("rec", "mb", "rec_pm", "mb_pm"))."""
+        return tuple(self._streams)
+
     # -- feeding --------------------------------------------------------
 
     def observe(
@@ -404,12 +409,20 @@ class EngineLeakMonitor:
         cfg: LeakMonitorConfig | None = None,
         registry: TelemetryRegistry | None = None,
         recorder: FlightRecorder | None = None,
+        mb_pm_leaves: int | None = None,
+        rec_pm_leaves: int | None = None,
     ):
         self.cfg = cfg or LeakMonitorConfig()
         self.mb_choices = mb_choices
-        self.monitor = TranscriptLeakMonitor(
-            {"rec": rec_leaves, "mb": mb_leaves}, self.cfg, registry
-        )
+        trees = {"rec": rec_leaves, "mb": mb_leaves}
+        # recursive position map (oram/posmap.py): the internal ORAM's
+        # accesses ride the transcript as appended columns — they get
+        # their own detector streams sized to the *internal* leaf space
+        self._has_pm = mb_pm_leaves is not None and rec_pm_leaves is not None
+        if self._has_pm:
+            trees["rec_pm"] = rec_pm_leaves
+            trees["mb_pm"] = mb_pm_leaves
+        self.monitor = TranscriptLeakMonitor(trees, self.cfg, registry)
         self.recorder = recorder or FlightRecorder(self.cfg.flight_capacity)
         self._c_rounds = self._c_dropped = self._c_transitions = None
         self._g_suspect = None
@@ -443,12 +456,15 @@ class EngineLeakMonitor:
         """Build a monitor sized to an engine's ORAM geometry, publishing
         into the engine's own telemetry registry (one merged /metrics)."""
         ecfg = engine.ecfg
+        recursive = ecfg.rec.posmap is not None
         return cls(
             mb_leaves=ecfg.mb.leaves,
             rec_leaves=ecfg.rec.leaves,
             mb_choices=ecfg.mb_choices,
             cfg=cfg,
             registry=engine.metrics.registry,
+            mb_pm_leaves=ecfg.mb.posmap.inner_leaves if recursive else None,
+            rec_pm_leaves=ecfg.rec.posmap.inner_leaves if recursive else None,
         )
 
     # -- round-path API (must stay O(1) and non-blocking) ---------------
@@ -508,10 +524,16 @@ class EngineLeakMonitor:
         tr = np.asarray(transcript)  # device→host copy, off the jit path
         # columns are [a_0..a_{D-1}, b, c_0..c_{D-1}] for the phase-major
         # engine (D = configured mb_choices) and [a, b, c] for the
-        # op-major one (always one fetch per mailbox round) — fall back
+        # op-major one (always one fetch per mailbox round); a recursive
+        # position map appends the internal ORAM's columns in the same
+        # layout, doubling the width (engine/round_step.py) — fall back
         # to the width-derived D when the configured one doesn't match
         d = self.mb_choices
-        if tr.shape[1] != 2 * d + 1:
+        pm_tr = None
+        if self._has_pm and tr.shape[1] == 2 * (2 * d + 1):
+            pm_tr = tr[:, 2 * d + 1:]
+            tr = tr[:, : 2 * d + 1]
+        elif tr.shape[1] != 2 * d + 1:
             d = max(1, (tr.shape[1] - 1) // 2)
         (mb_keys, mb_stable), (rec_keys, rec_stable) = transcript_key_groups(
             batch, d
@@ -522,6 +544,21 @@ class EngineLeakMonitor:
         self.monitor.observe("mb", mb_keys, tr[:, :d].ravel(), mb_stable)
         self.monitor.observe("rec", rec_keys, tr[:, d], rec_stable)
         self.monitor.observe("mb", mb_keys, tr[:, d + 1:].ravel(), mb_stable)
+        if pm_tr is not None:
+            # internal posmap accesses: grouped by the same host-visible
+            # keys as their outer rounds (two ops sharing an outer key
+            # share an internal block; distinct keys *may* also share a
+            # block — an undercount of same-key pairs, never a false
+            # SUSPECT — the transcript_key_groups stance). The internal
+            # round's own dedup makes every entry an independent uniform
+            # internal leaf, which these streams verify continuously.
+            self.monitor.observe(
+                "mb_pm", mb_keys, pm_tr[:, :d].ravel(), mb_stable
+            )
+            self.monitor.observe("rec_pm", rec_keys, pm_tr[:, d], rec_stable)
+            self.monitor.observe(
+                "mb_pm", mb_keys, pm_tr[:, d + 1:].ravel(), mb_stable
+            )
         if self._c_rounds is not None:
             self._c_rounds.inc()
         self._seq += 1
@@ -562,7 +599,8 @@ class EngineLeakMonitor:
             "n_real": int(n_real),
             "fill": round(n_real / batch_size, 4) if batch_size else 0.0,
             "phase_s": {k: round(float(x), 6) for k, x in phases.items()},
-            "stats": {t: self.monitor.stats(t) for t in ("rec", "mb")},
+            "stats": {t: self.monitor.stats(t)
+                      for t in self.monitor.streams},
             "verdict": v["verdict"],
         })
 

@@ -32,6 +32,17 @@ Host-side phases (histograms + ``jax.profiler`` annotations):
                   from the rest of the ``evict`` phase without touching
                   the hot path. Labelled batch-level by construction
                   (geometry only, never request data).
+- ``posmap``    — per-round position-resolution cost, measured the same
+                  calibration way (GrapevineEngine.calibrate_posmap_phase
+                  runs the round's exact lookup_and_remap workload —
+                  all three ORAM rounds' batch lookups — standalone at
+                  the round geometry): under a recursive position map
+                  (oram/posmap.py) this is the internal ORAM's rounds,
+                  under a flat one the private gather/scatter pair, so
+                  /trace and the flight recorder attribute position
+                  handling separately from ``oram_evict``. Also a
+                  device_phase scope inside the jit'd round for TPU
+                  profiler captures.
 
 Device-side scopes (``device_phase``): named_scope annotations compiled
 into the jit'd round so TPU profiler captures (tools/tpu_capture.py
@@ -46,7 +57,7 @@ import time
 #: canonical phase label values — the registry declares exactly these,
 #: so a typo'd phase name raises instead of minting a new series
 PHASES = ("assembly", "verify", "dispatch", "evict", "demux", "sweep",
-          "journal", "checkpoint", "replay", "sort")
+          "journal", "checkpoint", "replay", "sort", "posmap")
 
 #: fixed histogram boundaries for phase durations (seconds). Spans the
 #: measured range: ~100 µs host phases at B=8 up to multi-second expiry

@@ -136,6 +136,13 @@ class OramConfig:
     cipher_impl: str = "jnp"
     #: logical block index space [0, n_blocks); None = leaves
     n_blocks: int | None = None
+    #: position-map geometry (oram/posmap.py): None = the flat private
+    #: u32[blocks+1] table; a PosMapSpec = the recursive position ORAM
+    #: (state.posmap becomes a RecursivePosMapState pytree, and the
+    #: bucket tree carries a per-slot leaf-metadata plane so eviction
+    #: never consults the map). Part of the hashable static geometry —
+    #: jit static args and the checkpoint fingerprint cover it.
+    posmap: "object | None" = None
 
     @property
     def encrypted(self) -> bool:
@@ -196,9 +203,26 @@ class OramState(NamedTuple):
 
     tree_idx: jax.Array  # u32[n_buckets * Z] flat; SENTINEL = empty slot
     tree_val: jax.Array  # u32[n_buckets, Z*V]; one row per bucket
+    #: per-slot leaf assignment plane, recursive posmap only (u32[0]
+    #: under a flat map): with the map demoted to its own ORAM, eviction
+    #: can no longer gather the whole working set's leaves from a
+    #: private array, so each tree slot carries its block's leaf — the
+    #: classic recursive-construction bucket metadata (upstream
+    #: mc-oblivious stores leaves in buckets for exactly this reason).
+    #: Same shape/standing as tree_idx; encrypted at rest alongside it
+    #: (leaf_plane_cipher — a leaf is a *future* fetch path, strictly
+    #: snapshot-sensitive). Invariant: for every live block, this plane
+    #: equals what the position map answers (both are written from the
+    #: same op's new_leaf at its last within-round occurrence).
+    tree_leaf: jax.Array  # u32[n_buckets * Z] flat (or u32[0])
     stash_idx: jax.Array  # u32[S]
     stash_val: jax.Array  # u32[S, V]
-    posmap: jax.Array  # u32[blocks + 1] (last entry backs the dummy index)
+    #: stash mirror of tree_leaf (u32[S] recursive, u32[0] flat)
+    stash_leaf: jax.Array
+    #: position map: u32[blocks + 1] private table under a flat map
+    #: (last entry backs the dummy index), or a RecursivePosMapState
+    #: pytree (oram/posmap.py) when cfg.posmap is a PosMapSpec
+    posmap: jax.Array
     overflow: jax.Array  # u32 scalar, sticky count of dropped blocks
     #: at-rest cipher state (zero-sized semantics when cfg.cipher_rounds
     #: == 0): per-bucket 64-bit write-epoch nonce (0 = never written ⇒
@@ -212,22 +236,58 @@ def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
     """Empty tree; position map initialized with uniform random leaves.
 
     With the cipher enabled the all-zero initial tree is its own
-    ciphertext (epoch-0 convention, oblivious/bucket_cipher.py)."""
+    ciphertext (epoch-0 convention, oblivious/bucket_cipher.py). The
+    posmap pytree comes from oram/posmap.py: the flat u32[blocks+1]
+    table under ``cfg.posmap is None`` (bit-for-bit the pre-PR-7 draw),
+    or a RecursivePosMapState packing the same table values into an
+    internal Path ORAM. The recursive layout also activates the
+    per-slot leaf-metadata planes (zero-length otherwise)."""
+    from .posmap import init_posmap
+
     z, v = cfg.bucket_slots, cfg.value_words
     k_pos, k_cipher = jax.random.split(key)
+    n_leaf = cfg.n_buckets_padded * z if cfg.posmap is not None else 0
+    n_sleaf = cfg.stash_size if cfg.posmap is not None else 0
     return OramState(
         tree_idx=jnp.full((cfg.n_buckets_padded * z,), SENTINEL, U32),
         tree_val=jnp.zeros((cfg.n_buckets_padded, z * v), U32),
+        tree_leaf=jnp.zeros((n_leaf,), U32),
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
-        posmap=jax.random.randint(
-            k_pos, (cfg.blocks + 1,), 0, cfg.leaves, dtype=jnp.int32
-        ).astype(U32),
+        stash_leaf=jnp.zeros((n_sleaf,), U32),
+        posmap=init_posmap(cfg, k_pos),
         overflow=jnp.zeros((), U32),
         nonces=jnp.zeros((cfg.n_buckets_padded, 2), U32),
         cipher_key=jax.random.bits(k_cipher, (8,), U32),
         epoch=jnp.array([1, 0], U32),
     )
+
+
+def leaf_plane_cipher(
+    cfg: OramConfig,
+    key: jax.Array,
+    buckets: jax.Array,  # u32[R] heap bucket ids
+    epochs: jax.Array,  # u32[R, 2] per-row (lo, hi) nonce (0 = identity)
+    pleaf: jax.Array,  # u32[R, Z]
+) -> jax.Array:
+    """XOR leaf-metadata rows with their keystream (encrypt ≡ decrypt).
+
+    Recursive-posmap only: a slot's leaf value is the block's *future*
+    fetch path — at least as snapshot-sensitive as the slot index — so
+    the plane rides the bucket cipher. Domain separation from the
+    idx/val row keystream (cipher_rows) is the nonce's bucket word
+    offset by ``n_buckets_padded``: heap ids never reach that range, so
+    the leaf stream can never two-time-pad against the row stream under
+    the same (bucket, epoch). Kept out of ``cipher_rows`` on purpose —
+    the fused Pallas fetch/write kernels cover only the idx/val planes,
+    and this jnp path composes with all cipher_impls."""
+    if not cfg.encrypted:
+        return pleaf
+    ks = row_keystream(
+        key, buckets + U32(cfg.n_buckets_padded), epochs,
+        cfg.bucket_slots, cfg.cipher_rounds,
+    )
+    return pleaf ^ ks
 
 
 def path_bucket_indices(cfg: OramConfig, leaf: jax.Array) -> jax.Array:
@@ -324,6 +384,7 @@ def oram_access(
     operand,
     fn: Callable,
     axis_name: str | None = None,
+    pm_leaf: jax.Array | None = None,
 ):
     """One oblivious read-modify-write access.
 
@@ -337,7 +398,10 @@ def oram_access(
 
     ``fn`` must itself be branchless; it receives the *masked* value
     (zeros when absent). Returns ``(state', out, leaf)`` where ``leaf`` is
-    the public transcript entry for this access.
+    the public transcript entry for this access — a u32 scalar under a
+    flat map, u32[2] (payload leaf, internal posmap leaf) under a
+    recursive one (``cfg.posmap`` set; ``pm_leaf`` must then supply a
+    fresh uniform internal leaf — oram/posmap.py:lookup_remap_one).
 
     With ``axis_name`` set (inside ``shard_map``), the tree arrays are
     sharded along the bucket axis across the mesh and path fetch/write-back
@@ -345,9 +409,17 @@ def oram_access(
     are replicated — every chip runs the identical branchless program.
     """
     z, v, plen = cfg.bucket_slots, cfg.value_words, cfg.path_len
+    recursive = cfg.posmap is not None
 
-    leaf = state.posmap[idx]
-    posmap = state.posmap.at[idx].set(new_leaf)
+    if recursive:
+        from .posmap import lookup_remap_one
+
+        posmap, leaf, inner_leaf = lookup_remap_one(
+            cfg, state.posmap, idx, new_leaf, pm_leaf
+        )
+    else:
+        leaf = state.posmap[idx]
+        posmap = state.posmap.at[idx].set(new_leaf)
 
     path_b = path_bucket_indices(cfg, leaf)  # u32[plen]
     slot_b = path_slot_indices(cfg, path_b).reshape(-1)  # u32[plen*z]
@@ -360,16 +432,30 @@ def oram_access(
         pidx, pval = cipher_rows(
             cfg, state.cipher_key, path_b, pnonce, pidx.reshape(plen, z), pval
         )
+        if recursive:
+            pleaf = _path_gather(state.tree_leaf, slot_b, axis_name)
+            pleaf = leaf_plane_cipher(
+                cfg, state.cipher_key, path_b, pnonce, pleaf.reshape(plen, z)
+            ).reshape(-1)
     pidx = pidx.reshape(-1)
     pval = pval.reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
     wval = jnp.concatenate([state.stash_val, pval], axis=0)
-    # leaves come from the (already remapped) private posmap: for the
-    # accessed block that is new_leaf, for others their current leaf
-    wleaf = working_leaves(posmap, cfg, widx)
+    if recursive:
+        # leaves ride the per-slot metadata plane (the map can no longer
+        # be gathered); the accessed block reads its fresh leaf below
+        wleaf = jnp.concatenate([state.stash_leaf, pleaf])
+    else:
+        # leaves come from the (already remapped) private posmap: for the
+        # accessed block that is new_leaf, for others their current leaf
+        wleaf = working_leaves(posmap, cfg, widx)
 
     valid = widx != SENTINEL
     match = valid & (widx == idx)
+    if recursive:
+        # posmap↔metadata invariant: the map's entry for idx is already
+        # new_leaf (remapped above), so the metadata row follows suit
+        wleaf = jnp.where(match, new_leaf, wleaf)
     present = jnp.any(match)
     value = onehot_select(match, wval)
 
@@ -412,6 +498,9 @@ def oram_access(
         new_pidx = jnp.full((plen * z,), SENTINEL, U32).at[target].set(widx, mode="drop")
         new_pval = jnp.zeros((plen * z, v), U32).at[target].set(wval, mode="drop")
 
+    if recursive:
+        new_pleaf = jnp.zeros((plen * z,), U32).at[target].set(wleaf, mode="drop")
+
     # --- compact the leftovers back into the stash ---------------------
     leftover = valid & ~placed
     srank = rank_of(leftover)
@@ -420,6 +509,11 @@ def oram_access(
         widx, mode="drop"
     )
     stash_val = jnp.zeros((cfg.stash_size, v), U32).at[starget].set(wval, mode="drop")
+    stash_leaf = (
+        jnp.zeros((cfg.stash_size,), U32).at[starget].set(wleaf, mode="drop")
+        if recursive
+        else state.stash_leaf
+    )
     stash_dropped = jnp.sum(leftover) - jnp.minimum(
         jnp.sum(leftover), cfg.stash_size
     )
@@ -446,19 +540,33 @@ def oram_access(
             if cfg.encrypted
             else state.nonces
         )
+        if recursive:
+            enc_pleaf = leaf_plane_cipher(
+                cfg, state.cipher_key, path_b, epochs_w,
+                new_pleaf.reshape(plen, z),
+            )
+            tree_leaf = _path_scatter(
+                state.tree_leaf, slot_b, enc_pleaf.reshape(-1), axis_name
+            )
+        else:
+            tree_leaf = state.tree_leaf
     new_state = OramState(
         tree_idx=_path_scatter(
             state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name
         ),
         tree_val=_path_scatter(state.tree_val, path_b, enc_pval, axis_name),
+        tree_leaf=tree_leaf,
         stash_idx=stash_idx,
         stash_val=stash_val,
+        stash_leaf=stash_leaf,
         posmap=posmap,
         overflow=overflow,
         nonces=nonces,
         cipher_key=state.cipher_key,
         epoch=epoch_next(state.epoch),
     )
+    if recursive:
+        leaf = jnp.stack([leaf, inner_leaf])
     return new_state, out, leaf
 
 
@@ -470,6 +578,7 @@ def oram_access_batch(
     operands,  # pytree with leading batch axis
     fn: Callable,
     axis_name: str | None = None,
+    pm_leaves: jax.Array | None = None,  # u32[B] (recursive posmap only)
 ):
     """Sequentially-committed batch of accesses under one ``lax.scan``.
 
@@ -478,15 +587,28 @@ def oram_access_batch(
     SURVEY.md §7.6). Each scan iteration is itself a wide vector program,
     so the device pipelines the per-op work without host round-trips.
 
-    Returns ``(state', outs, leaves)`` with outs/leaves batched.
+    Returns ``(state', outs, leaves)`` with outs/leaves batched; under a
+    recursive posmap (``cfg.posmap`` set) ``pm_leaves`` supplies one
+    fresh uniform internal leaf per access and ``leaves`` is u32[B, 2].
     """
+    recursive = cfg.posmap is not None
+    if recursive and pm_leaves is None:
+        raise ValueError(
+            "recursive posmap batch needs pm_leaves (fresh uniform "
+            "internal leaves, one per access)"
+        )
 
     def step(carry, xs):
-        idx, new_leaf, opnd = xs
-        carry, out, leaf = oram_access(cfg, carry, idx, new_leaf, opnd, fn, axis_name)
+        idx, new_leaf, pm_leaf, opnd = xs
+        carry, out, leaf = oram_access(
+            cfg, carry, idx, new_leaf, opnd, fn, axis_name, pm_leaf=pm_leaf
+        )
         return carry, (out, leaf)
 
-    state, (outs, leaves) = jax.lax.scan(step, state, (idxs, new_leaves, operands))
+    pm = pm_leaves if recursive else jnp.zeros_like(new_leaves)
+    state, (outs, leaves) = jax.lax.scan(
+        step, state, (idxs, new_leaves, pm, operands)
+    )
     return state, outs, leaves
 
 

@@ -153,6 +153,8 @@ def oram_round(
     axis_name: str | None = None,
     occ_impl: str = "dense",
     sort_impl: str = "xla",
+    pm_new_leaves: jax.Array | None = None,  # u32[B] (recursive posmap)
+    pm_dummy_leaves: jax.Array | None = None,  # u32[B] (recursive posmap)
 ):
     """One batched oblivious access round over this ORAM.
 
@@ -182,11 +184,20 @@ def oram_round(
     permutations, zero ``sort`` HLO in this round (matches the engine's
     ``GrapevineConfig.sort_impl`` knob; CI-audited in
     tests/test_radix.py).
+
+    With a recursive position map (``cfg.posmap`` set; oram/posmap.py)
+    ``pm_new_leaves``/``pm_dummy_leaves`` must supply fresh uniform
+    *internal* leaves and the returned ``leaves`` is u32[B, 2]: column 0
+    the payload-tree transcript, column 1 the internal posmap ORAM's —
+    exactly B internal accesses per round regardless of the indices.
     """
+    from .posmap import lookup_remap_round
+
     b = idxs.shape[0]
     z, v, plen, h = cfg.bucket_slots, cfg.value_words, cfg.path_len, cfg.height
     s = cfg.stash_size
     nslots = b * plen * z
+    recursive = cfg.posmap is not None
 
     # --- 1. dedup, position-map read/remap, path fetch -----------------
     if occ_impl == "scan":
@@ -197,14 +208,11 @@ def oram_round(
         )
     else:
         first_occ, last_occ, _ = occurrence_masks(idxs, cfg.dummy_index)
-    leaves = jnp.where(first_occ, state.posmap[idxs], dummy_leaves)
-    # last occurrence wins the remap; others drop out of bounds (the
-    # dummy slot posmap[blocks] is never read unmasked, so funneling
-    # dead writes there — the old scheme — only forced the scatter to
-    # assume colliding indices; dropping keeps in-bounds targets unique)
-    remap_tgt = jnp.where(last_occ, idxs, U32(cfg.blocks + 1))
-    posmap = state.posmap.at[remap_tgt].set(
-        new_leaves, mode="drop", unique_indices=True
+    posmap, leaves, inner_leaves = lookup_remap_round(
+        cfg, state.posmap, idxs, new_leaves, dummy_leaves,
+        first_occ, last_occ,
+        pm_new_leaves=pm_new_leaves, pm_dummy_leaves=pm_dummy_leaves,
+        occ_impl=occ_impl, sort_impl=sort_impl,
     )
 
     path_b = jax.vmap(lambda lf: path_bucket_indices(cfg, lf))(leaves)  # [B,plen]
@@ -244,6 +252,17 @@ def oram_round(
             )
         # non-owner copies of shared buckets are invalidated
         pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
+        if recursive:
+            # per-slot leaf metadata rides its own (jnp) cipher plane —
+            # the fused kernels cover only the idx/val planes
+            from .path_oram import leaf_plane_cipher
+
+            pleaf = _path_gather(state.tree_leaf, slot_b, axis_name)
+            pnonce_l = _path_gather(state.nonces, flat_b, axis_name)
+            pleaf = leaf_plane_cipher(
+                cfg, state.cipher_key, flat_b, pnonce_l,
+                pleaf.reshape(b * plen, z),
+            ).reshape(-1)
 
     w = s + nslots + b  # + b reserved rows for net inserts
     widx0 = jnp.concatenate(
@@ -292,10 +311,20 @@ def oram_round(
     )
     wval = wval0.at[row_tgt.astype(jnp.int32)].set(final_val, mode="drop")
 
-    # leaves for the whole working set come from the remapped private
-    # posmap (the authoritative assignment — the tree stores no leaves):
-    # rows touched this round already read back their op's new leaf
-    wleaf = working_leaves(posmap, cfg, widx)
+    if recursive:
+        # leaves ride the per-slot metadata plane (the map is its own
+        # ORAM now — it cannot be gathered); rows committed this round
+        # take their key's winning fresh leaf, the same value the map's
+        # remap just recorded (the posmap↔metadata invariant)
+        wleaf = jnp.concatenate(
+            [state.stash_leaf, pleaf, jnp.zeros((b,), U32)]
+        ).at[row_tgt].set(new_leaves, mode="drop")
+    else:
+        # leaves for the whole working set come from the remapped private
+        # posmap (the authoritative assignment — the tree stores no
+        # leaves): rows touched this round already read back their op's
+        # new leaf
+        wleaf = working_leaves(posmap, cfg, widx)
 
     # --- 3. joint level-synchronous greedy eviction --------------------
     # One argsort of the working set by leaf, then per level: entries
@@ -359,6 +388,10 @@ def oram_round(
         new_pval = jnp.zeros((nslots, v), U32).at[slot_tgt].set(
             wval, mode="drop", unique_indices=True
         )
+        if recursive:
+            new_pleaf = jnp.zeros((nslots,), U32).at[slot_tgt].set(
+                wleaf, mode="drop", unique_indices=True
+            )
 
         # --- 4. stash recompaction -------------------------------------
         leftover = valid & ~placed
@@ -369,6 +402,13 @@ def oram_round(
         )
         stash_val = jnp.zeros((s, v), U32).at[starget].set(
             wval, mode="drop", unique_indices=True
+        )
+        stash_leaf = (
+            jnp.zeros((s,), U32).at[starget].set(
+                wleaf, mode="drop", unique_indices=True
+            )
+            if recursive
+            else state.stash_leaf
         )
         n_left = jnp.sum(leftover.astype(jnp.int32))
         stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
@@ -420,15 +460,32 @@ def oram_round(
                 if cfg.encrypted
                 else state.nonces
             )
+        if recursive:
+            from .path_oram import leaf_plane_cipher
+
+            enc_pleaf = leaf_plane_cipher(
+                cfg, state.cipher_key, flat_b, epochs_w,
+                new_pleaf.reshape(b * plen, z),
+            )
+            tree_leaf_new = _path_scatter(
+                state.tree_leaf, slot_b, enc_pleaf.reshape(-1), axis_name,
+                fowner_slots,
+            )
+        else:
+            tree_leaf_new = state.tree_leaf
     new_state = OramState(
         tree_idx=tree_idx_new,
         tree_val=tree_val_new,
+        tree_leaf=tree_leaf_new,
         stash_idx=stash_idx,
         stash_val=stash_val,
+        stash_leaf=stash_leaf,
         posmap=posmap,
         overflow=state.overflow + stash_dropped,
         nonces=nonces,
         cipher_key=state.cipher_key,
         epoch=epoch_next(state.epoch),
     )
+    if recursive:
+        leaves = jnp.stack([leaves, inner_leaves], axis=1)
     return new_state, outs, leaves

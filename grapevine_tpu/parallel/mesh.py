@@ -64,8 +64,16 @@ def _oram_specs() -> OramState:
     return OramState(
         tree_idx=P(TREE_AXIS),
         tree_val=P(TREE_AXIS),
+        # leaf-metadata plane (recursive posmap): sharded like tree_idx;
+        # zero-length under a flat map (every shard is empty — valid)
+        tree_leaf=P(TREE_AXIS),
         stash_idx=P(),
         stash_val=P(),
+        stash_leaf=P(),
+        # flat: one replicated array. Recursive: a RecursivePosMapState
+        # pytree — the P() prefix replicates the whole internal ORAM
+        # (its own bucket tree included; sharding the *inner* tree along
+        # the bucket axis is the ROADMAP item 1/3 composition point)
         posmap=P(),
         overflow=P(),
         nonces=P(TREE_AXIS),

@@ -61,6 +61,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="cap on the round-collection window (default: scheduler's "
         "quiescence policy, 8ms cap / 2ms idle gap)",
     )
+    p.add_argument(
+        "--posmap-impl",
+        choices=["flat", "recursive"],
+        default=None,
+        help="position-map implementation (oram/posmap.py): 'flat' = "
+        "the private in-memory table (default via auto), 'recursive' = "
+        "a one-level recursive position ORAM — ~sqrt(capacity)× less "
+        "resident position memory for ~2× round path traffic, the "
+        "knob that takes one replica past 2^24 records (sizing table: "
+        "OPERATIONS.md §13). Responses are bit-identical either way. "
+        "Device-owning roles only — the frontend never touches a "
+        "position map",
+    )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument(
         "--identity-seed",
@@ -252,16 +265,23 @@ _DURABILITY_FLAGS = {"state_dir", "checkpoint_every_rounds",
 _TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
                     "profile_enable"}
 
+#: device-engine geometry knobs: only roles that build an engine take
+#: them — a frontend supplying --posmap-impl would silently configure
+#: nothing (its engine lives in another process)
+_ENGINE_GEOM_FLAGS = {"posmap_impl"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
              "metrics_port", "metrics_host"}
-            | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS,
+            | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
+            | _ENGINE_GEOM_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
                "seed", "verbose", "role", "metrics_port", "metrics_host"}
-              | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS,
+              | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
+              | _ENGINE_GEOM_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
@@ -371,6 +391,7 @@ def main(argv=None) -> int:
         max_recipients=args.recipient_capacity,
         expiry_period=args.expiry_period,
         batch_size=args.batch_size,
+        posmap_impl=args.posmap_impl,
     )
     identity = None
     if args.identity_seed:

@@ -218,6 +218,10 @@ class EngineServer:
             self.engine.calibrate_sort_phase()
         except Exception:  # best-effort: metrics must still bind
             pass
+        try:  # and the "posmap" position-resolution split (PR 7)
+            self.engine.calibrate_posmap_phase()
+        except Exception:
+            pass
         lm = self.leakmon
         self._metrics_server = MetricsServer(
             self.engine.metrics.registry,

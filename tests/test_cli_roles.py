@@ -29,6 +29,10 @@ def _check(argv):
     ["--role", "frontend", "--trace-ring-size", "512"],
     ["--role", "frontend", "--slo-commit-p99-ms", "250.0"],
     ["--role", "frontend", "--profile-enable"],
+    # engine geometry lives with the device: a frontend supplying
+    # --posmap-impl would silently configure nothing (ISSUE 7 satellite)
+    ["--role", "frontend", "--posmap-impl", "recursive"],
+    ["--role", "frontend", "--posmap-impl", "flat"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -55,6 +59,10 @@ def test_misapplied_flags_rejected(argv):
     ["--role", "engine", "--engine-listen", "127.0.0.1:0",
      "--trace-ring-size", "64", "--slo-commit-p99-ms", "500.5",
      "--profile-enable"],
+    # device-owning roles take the position-map knob (ISSUE 7)
+    ["--role", "mono", "--posmap-impl", "recursive"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--posmap-impl", "flat"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

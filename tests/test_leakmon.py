@@ -271,6 +271,13 @@ def test_flight_recorder_dump_is_batch_level_only():
         "verdict": "PASS",
     }
     fr.record(ok)
+    # a recursive posmap engine's rounds carry the internal-ORAM streams
+    # too (leakmon *_pm, PR 7) — the schema must admit them or every
+    # round with --posmap-impl recursive raises in the leakmon worker
+    fr.record({**ok, "stats": {
+        t: {"uniformity_z": 0.1, "pooled_leaves": 64}
+        for t in ("rec", "mb", "rec_pm", "mb_pm")
+    }})
     for bad in (
         {"recipient": "deadbeef"},            # identity field
         {"msg_id": 7},                        # message id field
@@ -284,7 +291,7 @@ def test_flight_recorder_dump_is_batch_level_only():
             fr.record(bad)
     # the dump round-trips as JSON and carries only schema'd fields
     dump = json.loads(fr.dump_json())
-    assert dump["retained"] == 1
+    assert dump["retained"] == 2  # the ok summary + the *_pm one
     from grapevine_tpu.obs.flightrec import ALLOWED_FIELDS
 
     for summary in dump["rounds"]:

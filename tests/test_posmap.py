@@ -1,0 +1,324 @@
+"""Directed position-map subsystem suite (oram/posmap.py, PR 7).
+
+Always-on coverage (no engine compiles — everything here runs on small
+standalone ORAMs or pure traces, per the ROADMAP tier-1 budget rule):
+
+- recursion geometry derivation (k ≈ sqrt(blocks), caps, loud refusals);
+- pack/unpack: the recursive map's logical table is bit-identical to
+  the flat draw from the same PRNG key, through init and after rounds;
+- lookup/remap semantics: round-start reads, remap-visible-on-next-
+  lookup, within-round dedup of same-idx lookups, dummy handling;
+- the op-major single-access path (oram_access with pm_leaf);
+- 2^30-record geometry: shape-only construction + the capacity
+  acceptance (position-handling private memory ≤ 1/64 of flat);
+- the CI access-schedule gate (tools/check_posmap_oblivious.py), wired
+  here next to the telemetry/seal/perf gates.
+
+The flat↔recursive↔oracle *engine* campaigns live in
+tests/test_posmap_ab.py (fast pair always-on, breadth under -m slow).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grapevine_tpu.oram.path_oram import OramConfig, init_oram, oram_access
+from grapevine_tpu.oram.posmap import (
+    MIN_RECURSIVE_BLOCKS,
+    derive_posmap_spec,
+    inner_oram_config,
+    lookup_remap_one,
+    lookup_remap_round,
+    posmap_hbm_bytes,
+    posmap_private_bytes,
+    read_table,
+)
+from grapevine_tpu.oram.round import occurrence_masks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+U32 = jnp.uint32
+
+
+def _cfg_pair(blocks=32, height=4, value_words=4, cipher=0, k=None):
+    flat = OramConfig(
+        height=height, value_words=value_words, n_blocks=blocks,
+        cipher_rounds=cipher,
+    )
+    spec = derive_posmap_spec(
+        blocks, cipher_rounds=cipher, entries_per_block=k
+    )
+    rec = OramConfig(
+        height=height, value_words=value_words, n_blocks=blocks,
+        cipher_rounds=cipher, posmap=spec,
+    )
+    return flat, rec
+
+
+# -- geometry derivation ------------------------------------------------
+
+
+def test_derive_spec_sqrt_k_and_caps():
+    s = derive_posmap_spec(1 << 20)
+    assert s.entries_per_block == 1 << 10  # sqrt
+    assert s.inner_blocks == 1 << 10
+    assert s.inner_leaves == s.inner_blocks // 2  # density-2 layout
+    big = derive_posmap_spec(1 << 30)
+    assert big.entries_per_block == 1 << 10  # capped at 2^10
+    assert big.inner_blocks == 1 << 20
+    small = derive_posmap_spec(MIN_RECURSIVE_BLOCKS)
+    assert small.inner_blocks >= 4
+
+
+@pytest.mark.parametrize("blocks", [0, 1, 4, 48, (1 << 20) + 1])
+def test_derive_spec_refuses_bad_block_spaces(blocks):
+    with pytest.raises(ValueError, match="power-of-two"):
+        derive_posmap_spec(blocks)
+
+
+@pytest.mark.parametrize("k", [3, 1, 64, 256])
+def test_derive_spec_refuses_bad_explicit_k(k):
+    # 3: not a power of two; 1: < 2; 64/256: blocks/k < 4 at blocks=128
+    with pytest.raises(ValueError, match="entries_per_block"):
+        derive_posmap_spec(128, entries_per_block=k)
+
+
+def test_inner_config_is_flat_density2():
+    s = derive_posmap_spec(1 << 12)
+    icfg = inner_oram_config(s)
+    assert icfg.posmap is None  # one level of recursion only
+    assert icfg.value_words == s.entries_per_block
+    assert icfg.blocks == s.inner_blocks
+    assert icfg.leaves * 2 == s.inner_blocks
+
+
+# -- pack/unpack + init bit-identity ------------------------------------
+
+
+@pytest.mark.parametrize("cipher", [0, 8])
+def test_initial_table_bit_identical_to_flat_draw(cipher):
+    flat, rec = _cfg_pair(cipher=cipher)
+    key = jax.random.PRNGKey(42)
+    st_f = init_oram(flat, key)
+    st_r = init_oram(rec, key)
+    assert np.array_equal(
+        np.asarray(st_f.posmap)[: flat.blocks], read_table(rec, st_r.posmap)
+    )
+    # recursive activates the leaf-metadata planes; flat keeps them empty
+    assert st_r.tree_leaf.shape == st_r.tree_idx.shape
+    assert st_r.stash_leaf.shape == (rec.stash_size,)
+    assert st_f.tree_leaf.shape == (0,)
+    assert st_f.stash_leaf.shape == (0,)
+
+
+def test_inner_tree_holds_every_block_and_posmap_matches():
+    _, rec = _cfg_pair(blocks=64, height=5)
+    st = init_oram(rec, jax.random.PRNGKey(1))
+    inner = st.posmap.inner
+    icfg = inner_oram_config(rec.posmap)
+    from grapevine_tpu.oblivious.primitives import SENTINEL
+
+    tidx = np.asarray(inner.tree_idx)
+    live = tidx[tidx != int(SENTINEL)]
+    assert sorted(live.tolist()) == list(range(icfg.blocks))  # full, unique
+    # the inner flat map agrees with where each block actually sits
+    z = icfg.bucket_slots
+    pm = np.asarray(inner.posmap)
+    for slot in np.nonzero(tidx != int(SENTINEL))[0]:
+        hb = slot // z
+        depth_leaf = hb - ((1 << icfg.height) - 1)
+        assert 0 <= depth_leaf < icfg.leaves  # placed at leaf level
+        assert pm[tidx[slot]] == depth_leaf
+
+
+# -- lookup/remap semantics (round form) --------------------------------
+
+
+def _round_lookup(cfg, pm, idxs, nl, dl, pm_nl=None, pm_dl=None):
+    fo, lo, _ = occurrence_masks(idxs, cfg.dummy_index)
+    return lookup_remap_round(
+        cfg, pm, idxs, nl, dl, fo, lo,
+        pm_new_leaves=pm_nl, pm_dummy_leaves=pm_dl,
+    )
+
+
+@pytest.mark.parametrize("cipher", [0, 8])
+def test_round_lookup_matches_flat_and_remap_visible_next_round(cipher):
+    flat, rec = _cfg_pair(cipher=cipher)
+    key = jax.random.PRNGKey(3)
+    pm_f = init_oram(flat, key).posmap
+    pm_r = init_oram(rec, key).posmap
+    spec = rec.posmap
+    rng = np.random.default_rng(0)
+    k2 = jax.random.PRNGKey(9)
+    for r in range(4):
+        b = 8
+        k2, ka, kb, kc, kd = jax.random.split(k2, 5)
+        idxs = jnp.asarray(rng.integers(0, flat.blocks + 1, b).astype(np.uint32))
+        nl = jax.random.bits(ka, (b,), U32) & U32(flat.leaves - 1)
+        dl = jax.random.bits(kb, (b,), U32) & U32(flat.leaves - 1)
+        pm_nl = jax.random.bits(kc, (b,), U32) & U32(spec.inner_leaves - 1)
+        pm_dl = jax.random.bits(kd, (b,), U32) & U32(spec.inner_leaves - 1)
+        pm_f, lv_f, none_inner = _round_lookup(flat, pm_f, idxs, nl, dl)
+        pm_r, lv_r, inner = _round_lookup(rec, pm_r, idxs, nl, dl, pm_nl, pm_dl)
+        assert none_inner is None
+        assert inner is not None and inner.shape == (b,)
+        assert np.array_equal(np.asarray(lv_f), np.asarray(lv_r)), r
+        assert np.array_equal(
+            np.asarray(pm_f)[: flat.blocks], read_table(rec, pm_r)
+        ), f"remap not visible identically at round {r}"
+
+
+def test_round_lookup_dedups_same_idx():
+    """Duplicate indices in one batch: first occurrence reads the entry,
+    later ones take their dummy leaves, the LAST remap wins."""
+    flat, rec = _cfg_pair()
+    key = jax.random.PRNGKey(5)
+    pm_f = init_oram(flat, key).posmap
+    pm_r = init_oram(rec, key).posmap
+    start = int(pm_f[7])
+    idxs = jnp.asarray(np.array([7, 7, 7, 3], np.uint32))
+    nl = jnp.asarray(np.array([1, 2, 3, 4], np.uint32))
+    dl = jnp.asarray(np.array([9, 10, 11, 12], np.uint32))
+    pm_il = rec.posmap.inner_leaves
+    pm_nl = jnp.zeros((4,), U32) % U32(pm_il)
+    pm_dl = jnp.ones((4,), U32) % U32(pm_il)
+    pm_f2, lv_f, _ = _round_lookup(flat, pm_f, idxs, nl, dl)
+    pm_r2, lv_r, _ = _round_lookup(rec, pm_r, idxs, nl, dl, pm_nl, pm_dl)
+    want = [start, 10, 11, int(pm_f[3])]
+    assert np.asarray(lv_f).tolist() == want
+    assert np.asarray(lv_r).tolist() == want
+    assert int(pm_f2[7]) == 3  # last remap wins
+    assert read_table(rec, pm_r2)[7] == 3
+    assert read_table(rec, pm_r2)[3] == 4
+
+
+def test_round_lookup_requires_internal_leaves():
+    _, rec = _cfg_pair()
+    pm = init_oram(rec, jax.random.PRNGKey(0)).posmap
+    idxs = jnp.zeros((4,), U32)
+    with pytest.raises(ValueError, match="pm_new_leaves"):
+        _round_lookup(rec, pm, idxs, idxs, idxs)
+
+
+# -- lookup/remap semantics (single-access form + op-major ORAM) --------
+
+
+def test_one_lookup_remap_and_dummy_entry_mirror():
+    flat, rec = _cfg_pair()
+    key = jax.random.PRNGKey(11)
+    pm_f = init_oram(flat, key).posmap
+    pm_r = init_oram(rec, key).posmap
+    # real access: same read, remap visible on the next lookup
+    pm_f2, leaf_f = pm_f.at[5].set(U32(9)), pm_f[5]
+    pm_r2, leaf_r, il = lookup_remap_one(rec, pm_r, U32(5), U32(9), U32(0))
+    assert int(leaf_f) == int(leaf_r)
+    _, leaf_r3, _ = lookup_remap_one(rec, pm_r2, U32(5), U32(2), U32(1))
+    assert int(leaf_r3) == 9
+    # dummy access mirrors flat's table[blocks] read/remap
+    dummy = U32(rec.dummy_index)
+    pm_r4, leaf_d, _ = lookup_remap_one(rec, pm_r2, dummy, U32(6), U32(1))
+    assert int(leaf_d) == int(pm_r2.dummy_entry)
+    assert int(pm_r4.dummy_entry) == 6
+    with pytest.raises(ValueError, match="pm_leaf"):
+        lookup_remap_one(rec, pm_r, U32(5), U32(9))
+
+
+@pytest.mark.parametrize("cipher", [0, 8])
+def test_op_major_oram_access_bit_identical(cipher):
+    """The sequential oram_access path under both impls: same outputs,
+    same payload tree, logical tables stay equal."""
+    flat, rec = _cfg_pair(cipher=cipher)
+    key = jax.random.PRNGKey(2)
+    st_f = init_oram(flat, key)
+    st_r = init_oram(rec, key)
+
+    def kv(value, present, operand):
+        new = jnp.where(present, value + U32(1), operand)
+        return new, jnp.bool_(True), jnp.bool_(True), (value, present)
+
+    rng = np.random.default_rng(4)
+    k2 = jax.random.PRNGKey(21)
+    for i in range(12):
+        k2, ka, kb = jax.random.split(k2, 3)
+        idx = U32(int(rng.integers(0, flat.blocks + 1)))
+        nl = jax.random.bits(ka, (), U32) & U32(flat.leaves - 1)
+        pml = jax.random.bits(kb, (), U32) & U32(
+            rec.posmap.inner_leaves - 1
+        )
+        opnd = jnp.full((flat.value_words,), U32(i + 1))
+        st_f, out_f, leaf_f = oram_access(flat, st_f, idx, nl, opnd, kv)
+        st_r, out_r, leaf_r = oram_access(
+            rec, st_r, idx, nl, opnd, kv, pm_leaf=pml
+        )
+        assert np.array_equal(np.asarray(out_f[0]), np.asarray(out_r[0])), i
+        assert bool(out_f[1]) == bool(out_r[1])
+        assert int(leaf_f) == int(np.asarray(leaf_r)[0])  # [payload, pm]
+        assert np.asarray(leaf_r).shape == (2,)
+        assert np.array_equal(np.asarray(st_f.tree_idx), np.asarray(st_r.tree_idx))
+        assert np.array_equal(np.asarray(st_f.tree_val), np.asarray(st_r.tree_val))
+        assert np.array_equal(np.asarray(st_f.stash_idx), np.asarray(st_r.stash_idx))
+        assert int(st_r.overflow) == 0
+    assert np.array_equal(
+        np.asarray(st_f.posmap)[: flat.blocks], read_table(rec, st_r.posmap)
+    )
+
+
+# -- capacity: 2^30 records ---------------------------------------------
+
+
+def test_2pow30_geometry_constructs_shape_only():
+    """The ISSUE-7 capacity acceptance: a 2^30-logical-record geometry
+    constructs (shape-only — no 4 GiB tables materialize in CI) and its
+    resident position-handling memory is ≤ 1/64 of the flat map's."""
+    blocks = 1 << 30
+    spec = derive_posmap_spec(blocks)
+    flat = OramConfig(height=29, value_words=256, n_blocks=blocks)
+    rec = OramConfig(height=29, value_words=256, n_blocks=blocks, posmap=spec)
+    st = jax.eval_shape(lambda: init_oram(rec, jax.random.PRNGKey(0)))
+    # the resident pieces really shrank: inner table is blocks/k entries
+    assert st.posmap.inner.posmap.shape == (spec.inner_blocks + 1,)
+    assert st.tree_leaf.shape == st.tree_idx.shape
+    flat_bytes = posmap_private_bytes(flat)
+    rec_bytes = posmap_private_bytes(rec)
+    assert flat_bytes == 4 * (blocks + 1)  # the 4 GiB resident table
+    assert rec_bytes * 64 <= flat_bytes, (
+        f"private position memory {rec_bytes} not <= 1/64 of {flat_bytes}"
+    )
+    # and the HBM side is declared, not hidden: tree + leaf plane
+    assert posmap_hbm_bytes(rec) > 0
+    assert posmap_hbm_bytes(flat) == 0
+
+    # step the *small* standalone pieces of the same shape contract:
+    # the lookup round traces at this geometry (abstract values only)
+    def run(pm, idxs, nl, dl, pm_nl, pm_dl):
+        fo, lo, _ = occurrence_masks(idxs, rec.dummy_index)
+        return lookup_remap_round(
+            rec, pm, idxs, nl, dl, fo, lo,
+            pm_new_leaves=pm_nl, pm_dummy_leaves=pm_dl,
+        )
+
+    b = 4
+    lf = jax.ShapeDtypeStruct((b,), jnp.uint32)
+    out = jax.eval_shape(run, st.posmap, lf, lf, lf, lf, lf)
+    assert out[1].shape == (b,) and out[2].shape == (b,)
+
+
+# -- CI gate: access schedule is index-blind ----------------------------
+
+
+def test_posmap_access_schedule_gate():
+    """tools/check_posmap_oblivious.py wired into tier-1 (next to the
+    telemetry/seal/perf gates): identical traced program for adversarial
+    index sets, no data-dependent control flow, flat positive control."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_posmap_oblivious as gate
+
+    out = gate.check_posmap_access_schedule(b=12)
+    assert out["recursive"]["accesses"] > out["flat"]["accesses"]
+    assert out["flat"]["gathers"] >= 1 and out["flat"]["scatters"] >= 1

@@ -48,12 +48,13 @@ SWEEP_PERIOD = 10_000
 MAX_RESTARTS = 60
 
 
-def _config():
+def _config(posmap_impl: str | None = None):
     from grapevine_tpu.config import GrapevineConfig
 
     return GrapevineConfig(
         max_messages=64, max_recipients=8, mailbox_cap=4,
         batch_size=4, stash_size=64, bucket_cipher_rounds=0,
+        posmap_impl=posmap_impl,
     )
 
 
@@ -128,7 +129,9 @@ def run_child(args) -> int:
         checkpoint_every_rounds=args.checkpoint_every,
         journal_fsync_every=1,
     )
-    engine = GrapevineEngine(_config(), seed=ENGINE_SEED, durability=dcfg)
+    engine = GrapevineEngine(
+        _config(args.posmap_impl), seed=ENGINE_SEED, durability=dcfg
+    )
     monitor = EngineLeakMonitor.for_engine(
         engine, LeakMonitorConfig(window_rounds=64)
     )
@@ -160,12 +163,12 @@ def run_child(args) -> int:
     return 0
 
 
-def oracle(schedule_seed: int, n_events: int):
+def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None):
     """Uninterrupted in-process run: per-seq hashes + final state hash."""
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.engine.checkpoint import state_to_bytes
 
-    engine = GrapevineEngine(_config(), seed=ENGINE_SEED)
+    engine = GrapevineEngine(_config(posmap_impl), seed=ENGINE_SEED)
     events = build_schedule(schedule_seed, n_events)
     hashes: dict[int, str] = {}
     for i, ev in enumerate(events):
@@ -215,6 +218,8 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
             "--schedule-seed", str(args.schedule_seed),
             "--checkpoint-every", str(args.checkpoint_every),
         ]
+        if args.posmap_impl:
+            child_cmd += ["--posmap-impl", args.posmap_impl]
         base_env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
@@ -306,7 +311,9 @@ def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
     )
     os.makedirs(cache_dir, exist_ok=True)
     t0 = time.monotonic()
-    oracle_hashes, oracle_final = oracle(args.schedule_seed, args.events)
+    oracle_hashes, oracle_final = oracle(
+        args.schedule_seed, args.events, args.posmap_impl
+    )
     print(f"oracle: {len(oracle_hashes)} events in "
           f"{time.monotonic() - t0:.1f}s", flush=True)
     if modes is None:
@@ -336,6 +343,10 @@ def parse_args(argv):
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--timer-max-s", type=float, default=12.0)
+    p.add_argument("--posmap-impl", default=None,
+                   choices=["flat", "recursive"],
+                   help="position-map implementation under test "
+                   "(oram/posmap.py); default = the engine auto (flat)")
     return p.parse_args(argv)
 
 

@@ -19,6 +19,9 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
 4c. sort_perf  — xla vs radix bounded-key sort engine A/B (decides the
                  device sort_impl default: serial-scatter-bound on CPU,
                  open question on TPU where scatters vectorize)
+4d. posmap_perf — flat vs recursive position map A/B (prices the
+                 recursive map's whole-round overhead on a real chip —
+                 the capacity knob's cost side, OPERATIONS.md §13)
 5. oblivious   — transcript equality + R/U/D timing z-scores from
                  TPU-executed rounds (tiny capacity; it is the compiled
                  schedule being tested, not scale)
@@ -92,12 +95,13 @@ def stage_probe(cap, args):
 
 
 def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
-              vphases=None, sort=None):
+              vphases=None, sort=None, posmap=None):
     """zipf_mixed through a chosen cipher impl at a chosen size, using
     bench.py's own machinery (same methodology as the driver bench).
     ``vphases`` selects the slot-order machinery ("dense"/"scan"),
-    ``sort`` the bounded-key sort engine ("xla"/"radix"); None = the
-    backend default for each."""
+    ``sort`` the bounded-key sort engine ("xla"/"radix"), ``posmap``
+    the position map ("flat"/"recursive"); None = the backend default
+    for each."""
     import jax
     import numpy as np
 
@@ -106,7 +110,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     t0 = time.perf_counter()
     cfg, ecfg, state, step = bench._mk_engine(
         1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl,
-        vphases_impl=vphases, sort_impl=sort,
+        vphases_impl=vphases, sort_impl=sort, posmap_impl=posmap,
     )
     batches = bench.make_batches(4, batch)
     compile_t0 = time.perf_counter()
@@ -116,7 +120,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     _, times, total = bench._run_rounds(ecfg, state, step, batches[1:], n_rounds)
     ops = batch * n_rounds
     cap.emit(stage_name, impl=impl, vphases=ecfg.vphases_impl,
-             sort=ecfg.sort_impl,
+             sort=ecfg.sort_impl, posmap=ecfg.posmap_impl,
              capacity_log2=cap_log2, batch=batch,
              rounds=n_rounds, ops_per_sec=round(ops / total, 1),
              p99_round_ms=round(bench._p99(times), 2),
@@ -323,6 +327,32 @@ def stage_sort_perf(cap, args):
         import bench
 
         cap.emit("sort_perf", machinery=bench.bench_sort_ab(smoke=False))
+
+
+def stage_posmap_perf(cap, args):
+    """Flat vs recursive position map ON TPU — the real-chip decision
+    number for ``posmap_impl`` (config.py; auto stays "flat" until this
+    stage shows the recursive map's extra internal-ORAM round hides
+    under the payload round's existing gather/scatter wall, or capacity
+    forces the flip regardless — OPERATIONS.md §13). Mirrors
+    ``sort_perf``: identical workload, the knob the only difference,
+    bit-identical impls (tests/test_posmap_ab.py) so the overhead
+    number is the whole story. Whole-round pairs at the headline
+    geometry plus the isolated lookup machinery grid (with the
+    private/HBM memory split) from bench ``posmap_ab``."""
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "posmap_perf", "jnp", cl, b, 8, posmap="flat")
+    _zipf_run(cap, "posmap_perf", "jnp", cl, b, 8, posmap="recursive")
+    if not args.quick:
+        # the capacity regime the knob exists for: the biggest tree the
+        # chip holds, where the flat table is at its most expensive
+        _zipf_run(cap, "posmap_perf", "jnp", 24, 1024, 6, posmap="flat")
+        _zipf_run(cap, "posmap_perf", "jnp", 24, 1024, 6,
+                  posmap="recursive")
+        # isolated machinery grid — position resolution priced alone
+        import bench
+
+        cap.emit("posmap_perf", machinery=bench.bench_posmap_ab(smoke=False))
 
 
 def stage_oblivious(cap, args):
@@ -540,6 +570,7 @@ STAGES = [
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
+    ("posmap_perf", stage_posmap_perf, 1800),
     ("oblivious", stage_oblivious, 900),
     ("fullbench", None, 2400),  # subprocess-only (see main loop)
 ]
