@@ -20,6 +20,10 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
                          machinery (B × capacity grid, with the
                          private/HBM memory split) + whole-round
                          B-sweep, interleaved (PR7; PERF.md Round 9)
+  4e. tree_cache_ab      tree-top cache A/B — isolated ORAM-round
+                         machinery (cap × B × k grid) + whole-round
+                         k ∈ {0,2,4,auto} B-sweep, interleaved
+                         (PR8; PERF.md Round 10)
   5. sharded             bucket-tree sharded over a device mesh (CPU
                          mesh subprocess when one chip is visible)
   6. server_loopback     full-stack gRPC: session crypto + batched
@@ -56,7 +60,7 @@ def _p99(times_s: list[float]) -> float:
 
 def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="jnp",
                vphases_impl=None, cipher_rounds=8, mailbox_cap=None,
-               sort_impl=None, posmap_impl=None):
+               sort_impl=None, posmap_impl=None, tree_top_cache=None):
     import jax
 
     from grapevine_tpu.config import GrapevineConfig
@@ -75,6 +79,7 @@ def _mk_engine(cap, recips, batch, stash=None, seed=0, density=2, cipher_impl="j
         vphases_impl=vphases_impl,
         sort_impl=sort_impl,
         posmap_impl=posmap_impl,
+        tree_top_cache_levels=tree_top_cache,
         **extra,
     )
     ecfg = EngineConfig.from_config(cfg)
@@ -816,6 +821,156 @@ def bench_posmap_ab(smoke):
     return out
 
 
+def bench_tree_cache_ab(smoke):
+    """Config 4e: tree-top cache A/B (PR8; ROADMAP item 1).
+
+    Two scopes, both interleaved min-of-N (the vphases/sort/posmap_ab
+    methodology):
+
+    - **machinery**: one records-shaped ``oram_round`` isolated (trivial
+      apply callback) with ``top_cache_levels`` the only knob — the
+      exact path gather/decrypt/evict/encrypt/scatter the cache cuts,
+      without the engine's vphases/response machinery diluting it.
+      Cap × B grid, cipher on (the cipher-row cut is part of the
+      claim), with the per-k resident cache bytes reported.
+    - **whole round**: engine B-sweep over k ∈ {0, 2, 4, auto} — what a
+      serving round actually pays.
+
+    Honest-reporting note (the PR-3/5 lesson): caching strictly removes
+    HBM gather/scatter rows and cipher work — there is no algorithmic
+    trade — but on this 2-vCPU sandbox the absolute win rides on how
+    much of the round the path traffic is at the swept geometry;
+    PERF.md Round 10 carries the analysis either way, and the on-chip
+    number lands via tools/tpu_capture.py ``tree_cache_perf``.
+    Override sweeps with GRAPEVINE_TREE_CACHE_AB_BS /
+    GRAPEVINE_TREE_CACHE_AB_CAPS."""
+    import os
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from grapevine_tpu.oram.path_oram import (
+        OramConfig,
+        init_oram,
+        tree_cache_private_bytes,
+    )
+    from grapevine_tpu.oram.round import oram_round
+
+    reps = 3 if smoke else 7
+    out = {"machinery": {}, "sweep": {}}
+
+    # --- machinery: one ORAM round isolated, cap × B grid --------------
+    caps = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_TREE_CACHE_AB_CAPS",
+            "4096" if smoke else "65536,1048576",
+        ).split(",")
+    ]
+    bs_m = (64,) if smoke else (256, 1024)
+    ks_m = (0, 2) if smoke else (0, 2, 4, 8)
+    rng = np.random.default_rng(5)
+    for cap_n in caps:
+        height = max(1, cap_n.bit_length() - 2)  # density-2 payload shape
+        for b in bs_m:
+            idxs = jnp.asarray(
+                rng.integers(0, cap_n + 1, b).astype(np.uint32)
+            )
+            # one leaf schedule shared by every k arm (the posmap_ab
+            # rule: the knob is the ONLY difference between arms —
+            # round cost is leaf-independent by obliviousness, but the
+            # A/B should not have to lean on that)
+            nl = jnp.asarray(
+                rng.integers(0, 1 << height, b).astype(np.uint32)
+            )
+            dl = jnp.asarray(
+                rng.integers(0, 1 << height, b).astype(np.uint32)
+            )
+            grid = {}
+            for k in ks_m:
+                cfg = OramConfig(
+                    height=height, value_words=64, n_blocks=cap_n,
+                    cipher_rounds=8, stash_size=max(96, b // 2 + 96),
+                    top_cache_levels=min(k, height),
+                )
+                state = init_oram(cfg, jax.random.PRNGKey(1))
+
+                def one(st, cfg=cfg):
+                    def apply_batch(vals0, present0):
+                        return jnp.sum(vals0, axis=1), vals0, present0
+
+                    st2, outs, leaves = oram_round(
+                        cfg, st, idxs, nl, dl, apply_batch
+                    )
+                    # full-output rule: the new state must be live or
+                    # XLA DCEs the write-back half of the round
+                    return st2, outs, leaves
+
+                t = _min_of(jax.jit(one), (state,), reps)
+                grid[f"k{k}"] = {
+                    "round_ms": round(t * 1e3, 3),
+                    "cache_kib": round(
+                        tree_cache_private_bytes(cfg) / 1024, 1
+                    ),
+                }
+            base = grid["k0"]["round_ms"]
+            for k in ks_m[1:]:
+                grid[f"k{k}"]["speedup_over_k0"] = round(
+                    base / grid[f"k{k}"]["round_ms"], 3
+                )
+            out["machinery"][f"round_cap{cap_n}_b{b}"] = grid
+
+    # --- whole round: tree_top_cache_levels the only knob --------------
+    sweep = [
+        int(x)
+        for x in os.environ.get(
+            "GRAPEVINE_TREE_CACHE_AB_BS", "64" if smoke else "256,1024"
+        ).split(",")
+    ]
+    ks = (0, 2) if smoke else (0, 2, 4, "auto")
+    n_timed = 3 if smoke else 9
+    for B in sweep:
+        ctxs = {}
+        for k in ks:
+            cfg, ecfg, state, step = _mk_engine(
+                1 << 12, 1 << 9, B, mailbox_cap=8,
+                tree_top_cache=None if k == "auto" else k,
+            )
+            batches = make_batches(3, B, seed=13)
+            state, resp, _ = step(ecfg, state, batches[0])
+            jax.block_until_ready(resp)
+            ctxs[k] = [ecfg, state, step, batches]
+
+        def one_round(ctx, i):
+            ecfg, state, step, batches = ctx
+            t0 = _time.perf_counter()
+            state, resp, _ = step(ecfg, state, batches[i % 3])
+            jax.block_until_ready(resp)
+            ctx[1] = state
+            return _time.perf_counter() - t0
+
+        times = {k: [] for k in ks}
+        for i in range(n_timed):  # interleaved A/B
+            for k in ks:
+                times[k].append(one_round(ctxs[k], i))
+        m0 = float(np.min(times[0]))
+        entry = {}
+        for k in ks:
+            mk = float(np.min(times[k]))
+            entry[f"k{k}"] = {
+                "round_ms": round(mk * 1e3, 2),
+                "median_round_ms": round(
+                    float(np.median(times[k])) * 1e3, 2
+                ),
+                "speedup_over_k0": round(m0 / mk, 3),
+            }
+            if k == "auto":
+                entry["kauto"]["resolved_k"] = ctxs[k][0].tree_top_cache_levels
+        out["sweep"][str(B)] = entry
+    return out
+
+
 def bench_expiry_sweep(smoke):
     """Config 4: full-bus timestamped eviction scan (reference
     README.md:86-98) at the largest capacity that fits one chip:
@@ -1229,6 +1384,7 @@ CONFIGS = [
     ("vphases_ab", bench_vphases_ab),
     ("sort_ab", bench_sort_ab),
     ("posmap_ab", bench_posmap_ab),
+    ("tree_cache_ab", bench_tree_cache_ab),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),

@@ -151,6 +151,19 @@ class GrapevineConfig:
                 "only posmap_impl='flat' — the recursive position map "
                 "rides the phase-major batched round"
             )
+        tc = self.tree_top_cache_levels
+        if tc is not None and (not isinstance(tc, int) or tc < 0):
+            raise ValueError(
+                f"tree_top_cache_levels must be None (auto) or an int "
+                f">= 0, got {tc!r}"
+            )
+        if self.commit == "op" and tc not in (None, 0):
+            raise ValueError(
+                "commit='op' (the differential-oracle engine) supports "
+                "only tree_top_cache_levels=0 — the tree-top cache "
+                "rides the phase-major batched round, and the op-major "
+                "engine stays cache-free as the differential oracle"
+            )
     #: slot-order semantics implementation for the phase-major engine's
     #: vectorized phases (engine/vphases.py): "dense" = [B,B] masked
     #: matrices + one-hot bool-matmuls (MXU-shaped; O(B²) compute and
@@ -211,6 +224,33 @@ class GrapevineConfig:
     #: on a real chip (the vphases/sort playbook). Requires
     #: commit="phase" and power-of-two block spaces >= 8 on both trees.
     posmap_impl: str | None = None
+
+    #: tree-top cache depth for every Path-ORAM bucket tree (records,
+    #: mailbox, and — under posmap_impl="recursive" — the internal
+    #: position trees; oram/path_oram.py). The top k levels (2^k−1
+    #: buckets) are on EVERY root→leaf path, so they are promoted out of
+    #: the per-access encrypted HBM gather/scatter into decrypted-
+    #: resident cache planes with the stash's private standing: path
+    #: fetch/write-back then touch only the bottom height+1−k levels of
+    #: the big tree arrays and the per-access cipher work shrinks by the
+    #: same fraction ("Optimizing Path ORAM for Cloud Storage
+    #: Applications" measures the ~2-3× path-bandwidth cut; Palermo
+    #: co-designs the same cache in hardware — ROADMAP item 1).
+    #: Access-pattern-neutral by construction — the cached levels are
+    #: touched by every access anyway, and the cache is read/written
+    #: with constant-shape programs (CI-audited,
+    #: tools/check_tree_cache_oblivious.py). Responses and logical state
+    #: are bit-identical at every k (tests/test_tree_cache.py).
+    #: 0 = off (bit-for-bit the uncached program); k is clamped to each
+    #: tree's height (at least the leaf level stays in HBM); memory cost
+    #: is (2^k−1)·bucket-row bytes per tree (OPERATIONS.md §14 sizing
+    #: table). None = auto per backend: 4 on TPU backends AND on CPU —
+    #: the cache strictly removes gather/scatter/cipher rows rather than
+    #: trading one algorithm for another, and the CPU A/B (bench.py
+    #: ``tree_cache_ab``, PERF.md Round 10) confirms the win off-TPU;
+    #: the on-chip number lands via tools/tpu_capture.py
+    #: ``tree_cache_perf``. Requires commit="phase".
+    tree_top_cache_levels: int | None = None
 
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient

@@ -636,8 +636,10 @@ class GrapevineEngine:
     def recipient_count(self) -> int:
         return int(self.state.recipients)
 
-    def sample_stash(self) -> None:
-        """Sample stash occupancy of both trees into the metrics gauges.
+    def sample_stash(self) -> dict:
+        """Sample stash occupancy of both trees into the metrics gauges;
+        returns the per-tree counts so health() reuses them instead of
+        re-running the device reductions under the lock.
 
         Called at scrape/health cadence, not per round: a device
         reduction every round would serialize the dispatch pipeline for
@@ -647,19 +649,29 @@ class GrapevineEngine:
 
         with self._lock:
             state = self.state
-            trees = [state.rec, state.mb]
+            trees = {"rec": state.rec, "mb": state.mb}
             if self.ecfg.rec.posmap is not None:
                 # recursive position maps (oram/posmap.py) carry their
                 # own internal ORAM whose stash fills under the same
                 # pressure — invisible here would mean silent position
                 # loss with a green gauge
-                trees += [state.rec.posmap.inner, state.mb.posmap.inner]
-            for tree in trees:
-                self.metrics.observe_stash(int(stash_occupancy(tree)))
+                trees["rec_pm"] = state.rec.posmap.inner
+                trees["mb_pm"] = state.mb.posmap.inner
+            counts = {
+                name: int(stash_occupancy(tree))
+                for name, tree in trees.items()
+            }
+        for n in counts.values():
+            self.metrics.observe_stash(n)
+        return counts
 
     def health(self) -> dict:
         """Aggregate state + batch-level counters (never per-client)."""
-        self.sample_stash()
+        # per-tree stash occupancy, batch-level (a tree-top cache bug
+        # would first show up as silent stash drift — the directed
+        # cached↔uncached soak in tests/test_tree_cache.py reads these,
+        # and operators get the same early signal)
+        occupancy = self.sample_stash()
         with self._lock:
             state = self.state  # one round's state for a consistent snapshot
             overflow = int(state.rec.overflow) + int(state.mb.overflow)
@@ -673,5 +685,6 @@ class GrapevineEngine:
                 "messages": self.ecfg.max_messages - int(state.free_top),
                 "recipients": int(state.recipients),
                 "stash_overflow": overflow,
+                "stash_occupancy": occupancy,
                 **self.metrics.snapshot(),
             }

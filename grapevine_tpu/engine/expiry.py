@@ -165,6 +165,20 @@ def expiry_sweep(
     with device_phase("sweep_records"):
         present, rec = _chunked_tree_sweep(rcfg, state.rec, present0, rec_body)
 
+        # tree-top cache planes (cfg.top_cache_levels > 0): the cached
+        # top buckets' live blocks exist ONLY here — their HBM rows are
+        # stale empty ciphertext, which the chunked pass above decrypts
+        # to empty rows and re-keys harmlessly. The cache is plaintext
+        # private state (stash standing), so it sweeps exactly like the
+        # stash: no cipher, no re-key, same expire body.
+        if rcfg.top_cache_levels:
+            zc = rcfg.bucket_slots
+            present, (cix, cvl) = rec_body(
+                present,
+                (rec.cache_idx.reshape(-1, zc), rec.cache_val),
+            )
+            rec = rec._replace(cache_idx=cix.reshape(-1), cache_val=cvl)
+
     # stash rows are plaintext private state
     st_live = state.rec.stash_idx != SENTINEL
     st_dead = st_live & _expired(
@@ -221,6 +235,17 @@ def expiry_sweep(
         recips, mb = _chunked_tree_sweep(
             ecfg.mb, state.mb, jnp.zeros((), U32), mb_body
         )
+        # mailbox tree-top cache: plaintext pass, stash standing (see
+        # the records cache sweep above)
+        if ecfg.mb.top_cache_levels:
+            zc = ecfg.mb.bucket_slots
+            mc_idx, mc_val, mc_keys = sweep_mb(
+                mb.cache_idx.reshape(-1, zc), mb.cache_val
+            )
+            recips = recips + live_keys(mc_keys, mc_idx)
+            mb = mb._replace(
+                cache_idx=mc_idx.reshape(-1), cache_val=mc_val
+            )
     mb_stash_idx, mb_stash_val, stash_keys = sweep_mb(
         state.mb.stash_idx, state.mb.stash_val
     )

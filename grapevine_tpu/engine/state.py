@@ -85,6 +85,12 @@ class EngineConfig:
     #: "recursive" — the per-tree geometry lives in rec.posmap/mb.posmap
     #: (PosMapSpec), which the checkpoint fingerprint covers via repr
     posmap_impl: str = "flat"
+    #: resolved tree-top cache depth (the requested k before per-tree
+    #: clamping; each tree's effective depth lives in
+    #: rec/mb.top_cache_levels and the inner posmap specs — all covered
+    #: by the checkpoint fingerprint via repr, so a cached checkpoint
+    #: can never silently restore into a differently-cached engine)
+    tree_top_cache_levels: int = 0
 
     @property
     def id_bits(self) -> int:
@@ -127,6 +133,20 @@ class EngineConfig:
         # capacity outgrows private memory (flip per OPERATIONS.md §13
         # or after tools/tpu_capture.py posmap_perf prices it on-chip)
         pimpl = cfg.posmap_impl if cfg.posmap_impl is not None else "flat"
+        # tree-top cache: auto = 4 on every backend under the phase
+        # engine (0 under commit="op" — the differential oracle stays
+        # cache-free). Unlike the radix/recursive knobs, caching never
+        # trades one algorithm for another: it strictly removes HBM
+        # gather/scatter rows and cipher work from every access, and the
+        # CPU A/B confirms the win off-TPU (bench.py tree_cache_ab,
+        # PERF.md Round 10); per-k sizing and flip guidance in
+        # OPERATIONS.md §14. Clamped per tree so at least the leaf
+        # level stays in HBM.
+        tc = cfg.tree_top_cache_levels
+        if tc is None:
+            tc = 4 if cfg.commit == "phase" else 0
+        rec_tc = min(tc, cfg.records_height)
+        mb_tc = min(tc, cfg.mailbox_height)
         rec_pm = mb_pm = None
         if pimpl == "recursive":
             from ..oram.posmap import derive_posmap_spec
@@ -135,11 +155,13 @@ class EngineConfig:
                 cfg.max_messages,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                top_cache_levels=tc,
             )
             mb_pm = derive_posmap_spec(
                 m,
                 stash_size=cfg.stash_size,
                 cipher_rounds=cfg.bucket_cipher_rounds,
+                top_cache_levels=tc,
             )
         return cls(
             max_messages=cfg.max_messages,
@@ -156,6 +178,7 @@ class EngineConfig:
                 cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=cfg.max_messages,
                 posmap=rec_pm,
+                top_cache_levels=rec_tc,
             ),
             mb=OramConfig(
                 height=cfg.mailbox_height,
@@ -166,6 +189,7 @@ class EngineConfig:
                 cipher_impl=cfg.bucket_cipher_impl,
                 n_blocks=m,
                 posmap=mb_pm,
+                top_cache_levels=mb_tc,
             ),
             mb_table_buckets=m,
             mb_slots=k,
@@ -173,6 +197,7 @@ class EngineConfig:
             vphases_impl=vimpl,
             sort_impl=simpl,
             posmap_impl=pimpl,
+            tree_top_cache_levels=tc,
         )
 
 

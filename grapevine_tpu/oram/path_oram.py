@@ -143,10 +143,35 @@ class OramConfig:
     #: never consults the map). Part of the hashable static geometry —
     #: jit static args and the checkpoint fingerprint cover it.
     posmap: "object | None" = None
+    #: tree-top cache (ROADMAP item 1, arXiv:1501.01721 §tree-top
+    #: caching): the top k levels — 2^k−1 buckets, on EVERY root→leaf
+    #: path, so caching them is access-pattern-neutral by construction —
+    #: live decrypted in the dense ``cache_*`` planes (private working
+    #: state, the stash's standing) instead of the encrypted HBM tree
+    #: rows. Path fetch/write-back then touch only the bottom
+    #: ``path_len − k`` levels of the big tree arrays, and the
+    #: per-access cipher work shrinks by the same fraction. 0 = off,
+    #: bit-for-bit the uncached program.
+    top_cache_levels: int = 0
+
+    def __post_init__(self):
+        k = self.top_cache_levels
+        if not (0 <= k <= self.height):
+            raise ValueError(
+                f"top_cache_levels must be in [0, height={self.height}] "
+                f"(at least the leaf level stays in the HBM tree), got {k}"
+            )
 
     @property
     def encrypted(self) -> bool:
         return self.cipher_rounds > 0
+
+    @property
+    def cache_buckets(self) -> int:
+        """Buckets resident in the tree-top cache: 2^k − 1 (heap indices
+        [0, 2^k−1) — the top k levels are a contiguous heap prefix, so
+        the cache planes are indexed by heap id directly)."""
+        return (1 << self.top_cache_levels) - 1
 
     @property
     def row_words(self) -> int:
@@ -203,6 +228,20 @@ class OramState(NamedTuple):
 
     tree_idx: jax.Array  # u32[n_buckets * Z] flat; SENTINEL = empty slot
     tree_val: jax.Array  # u32[n_buckets, Z*V]; one row per bucket
+    #: tree-top cache planes (cfg.top_cache_levels = k > 0; zero-length
+    #: otherwise): the decrypted-resident image of heap buckets
+    #: [0, 2^k−1) — the authoritative copy; those buckets' HBM tree rows
+    #: go stale (empty-at-init ciphertext, re-keyed but never read).
+    #: Private working state with the stash's standing (the EPC analog:
+    #: VMEM/registers on TPU, a donated array elsewhere) — every path
+    #: touches all k cached levels, so cache accesses are
+    #: access-pattern-neutral and the plane needs no cipher or nonces.
+    #: Sealed checkpoints cover it like any other leaf (engine/
+    #: checkpoint.py serializes the whole pytree).
+    cache_idx: jax.Array  # u32[cache_buckets * Z] (or u32[0])
+    cache_val: jax.Array  # u32[cache_buckets, Z*V] (or u32[0, Z*V])
+    #: cache mirror of tree_leaf (recursive posmap only; u32[0] else)
+    cache_leaf: jax.Array
     #: per-slot leaf assignment plane, recursive posmap only (u32[0]
     #: under a flat map): with the map demoted to its own ORAM, eviction
     #: can no longer gather the whole working set's leaves from a
@@ -248,9 +287,14 @@ def init_oram(cfg: OramConfig, key: jax.Array) -> OramState:
     k_pos, k_cipher = jax.random.split(key)
     n_leaf = cfg.n_buckets_padded * z if cfg.posmap is not None else 0
     n_sleaf = cfg.stash_size if cfg.posmap is not None else 0
+    cb = cfg.cache_buckets
+    n_cleaf = cb * z if cfg.posmap is not None else 0
     return OramState(
         tree_idx=jnp.full((cfg.n_buckets_padded * z,), SENTINEL, U32),
         tree_val=jnp.zeros((cfg.n_buckets_padded, z * v), U32),
+        cache_idx=jnp.full((cb * z,), SENTINEL, U32),
+        cache_val=jnp.zeros((cb, z * v), U32),
+        cache_leaf=jnp.zeros((n_cleaf,), U32),
         tree_leaf=jnp.zeros((n_leaf,), U32),
         stash_idx=jnp.full((cfg.stash_size,), SENTINEL, U32),
         stash_val=jnp.zeros((cfg.stash_size, v), U32),
@@ -422,21 +466,43 @@ def oram_access(
         posmap = state.posmap.at[idx].set(new_leaf)
 
     path_b = path_bucket_indices(cfg, leaf)  # u32[plen]
-    slot_b = path_slot_indices(cfg, path_b).reshape(-1)  # u32[plen*z]
+
+    # tree-top cache split: levels [0, kc) live decrypted in the cache
+    # planes; only the bottom plen−kc levels touch the encrypted HBM
+    # tree (and pay cipher work). kc=0 degenerates to the full path.
+    kc = cfg.top_cache_levels
+    bot_b = path_b[kc:]
+    bot_slots = path_slot_indices(cfg, bot_b).reshape(-1)
+    top_b = path_b[:kc]
+    top_slots = path_slot_indices(cfg, top_b).reshape(-1)
 
     # --- fetch path ∪ stash into the working set -----------------------
     with device_phase("oram_fetch"):
-        pidx = _path_gather(state.tree_idx, slot_b, axis_name)
-        pval = _path_gather(state.tree_val, path_b, axis_name)
-        pnonce = _path_gather(state.nonces, path_b, axis_name)
+        pidx = _path_gather(state.tree_idx, bot_slots, axis_name)
+        pval = _path_gather(state.tree_val, bot_b, axis_name)
+        pnonce = _path_gather(state.nonces, bot_b, axis_name)
         pidx, pval = cipher_rows(
-            cfg, state.cipher_key, path_b, pnonce, pidx.reshape(plen, z), pval
+            cfg, state.cipher_key, bot_b, pnonce,
+            pidx.reshape(plen - kc, z), pval,
         )
+        if kc:
+            # cached top levels: plain private gathers (same standing as
+            # the stash concatenate below — every path touches them)
+            pidx = jnp.concatenate(
+                [state.cache_idx[top_slots].reshape(kc, z), pidx]
+            )
+            pval = jnp.concatenate([state.cache_val[top_b], pval], axis=0)
         if recursive:
-            pleaf = _path_gather(state.tree_leaf, slot_b, axis_name)
+            pleaf = _path_gather(state.tree_leaf, bot_slots, axis_name)
             pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, path_b, pnonce, pleaf.reshape(plen, z)
-            ).reshape(-1)
+                cfg, state.cipher_key, bot_b, pnonce,
+                pleaf.reshape(plen - kc, z),
+            )
+            if kc:
+                pleaf = jnp.concatenate(
+                    [state.cache_leaf[top_slots].reshape(kc, z), pleaf]
+                )
+            pleaf = pleaf.reshape(-1)
     pidx = pidx.reshape(-1)
     pval = pval.reshape(-1, v)
     widx = jnp.concatenate([state.stash_idx, pidx])
@@ -526,35 +592,54 @@ def oram_access(
 
     # --- write the path back (write transcript ≡ read transcript) ------
     with device_phase("oram_writeback"):
-        epochs_w = jnp.broadcast_to(state.epoch[None, :], (plen, 2))
+        epochs_w = jnp.broadcast_to(state.epoch[None, :], (plen - kc, 2))
         enc_pidx, enc_pval = cipher_rows(
             cfg,
             state.cipher_key,
-            path_b,
+            bot_b,
             epochs_w,
-            new_pidx.reshape(plen, z),
-            new_pval.reshape(plen, z * v),
+            new_pidx.reshape(plen, z)[kc:],
+            new_pval.reshape(plen, z * v)[kc:],
         )
         nonces = (
-            _path_scatter(state.nonces, path_b, epochs_w, axis_name)
+            _path_scatter(state.nonces, bot_b, epochs_w, axis_name)
             if cfg.encrypted
             else state.nonces
         )
+        if kc:
+            # cached levels write back plaintext into the cache planes
+            # (a single path's buckets are distinct → unique targets)
+            cache_idx = state.cache_idx.at[top_slots].set(
+                new_pidx[: kc * z], unique_indices=True
+            )
+            cache_val = state.cache_val.at[top_b].set(
+                new_pval.reshape(plen, z * v)[:kc], unique_indices=True
+            )
+        else:
+            cache_idx, cache_val = state.cache_idx, state.cache_val
+        cache_leaf = state.cache_leaf
         if recursive:
             enc_pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, path_b, epochs_w,
-                new_pleaf.reshape(plen, z),
+                cfg, state.cipher_key, bot_b, epochs_w,
+                new_pleaf.reshape(plen, z)[kc:],
             )
             tree_leaf = _path_scatter(
-                state.tree_leaf, slot_b, enc_pleaf.reshape(-1), axis_name
+                state.tree_leaf, bot_slots, enc_pleaf.reshape(-1), axis_name
             )
+            if kc:
+                cache_leaf = state.cache_leaf.at[top_slots].set(
+                    new_pleaf[: kc * z], unique_indices=True
+                )
         else:
             tree_leaf = state.tree_leaf
     new_state = OramState(
         tree_idx=_path_scatter(
-            state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name
+            state.tree_idx, bot_slots, enc_pidx.reshape(-1), axis_name
         ),
-        tree_val=_path_scatter(state.tree_val, path_b, enc_pval, axis_name),
+        tree_val=_path_scatter(state.tree_val, bot_b, enc_pval, axis_name),
+        cache_idx=cache_idx,
+        cache_val=cache_val,
+        cache_leaf=cache_leaf,
         tree_leaf=tree_leaf,
         stash_idx=stash_idx,
         stash_val=stash_val,
@@ -610,6 +695,16 @@ def oram_access_batch(
         step, state, (idxs, new_leaves, pm, operands)
     )
     return state, outs, leaves
+
+
+def tree_cache_private_bytes(cfg: OramConfig) -> int:
+    """Decrypted-resident bytes the tree-top cache pins for this tree
+    (sizing helper for OPERATIONS.md §14 and bench.py tree_cache_ab):
+    2^k−1 bucket rows of idx + val (+ leaf-metadata under a recursive
+    posmap), all plaintext private state with the stash's standing."""
+    z, v = cfg.bucket_slots, cfg.value_words
+    leaf = z if cfg.posmap is not None else 0
+    return cfg.cache_buckets * 4 * (z + z * v + leaf)
 
 
 def stash_occupancy(state: OramState) -> jax.Array:

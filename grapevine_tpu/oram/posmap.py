@@ -95,6 +95,11 @@ class PosMapSpec:
     #: outer tree's setting; the internal map holds future fetch paths,
     #: so it is at least as snapshot-sensitive as payload)
     inner_cipher_rounds: int = 0
+    #: tree-top cache depth for the INTERNAL bucket tree (ROADMAP item
+    #: 1 ∘ item 5 composition: the internal tree's top levels are
+    #: touched every round too — path_oram.OramConfig.top_cache_levels,
+    #: clamped to inner_height by derive_posmap_spec)
+    inner_top_cache_levels: int = 0
 
     @property
     def inner_leaves(self) -> int:
@@ -106,6 +111,7 @@ def derive_posmap_spec(
     stash_size: int = 96,
     cipher_rounds: int = 0,
     entries_per_block: int | None = None,
+    top_cache_levels: int = 0,
 ) -> PosMapSpec:
     """Auto-derive recursion geometry from capacity.
 
@@ -132,12 +138,14 @@ def derive_posmap_spec(
                 f"blocks/k >= 4, got k={k} at blocks={blocks}"
             )
     inner_blocks = blocks // k
+    ih = max(1, inner_blocks.bit_length() - 2)
     return PosMapSpec(
         entries_per_block=k,
         inner_blocks=inner_blocks,
-        inner_height=max(1, inner_blocks.bit_length() - 2),
+        inner_height=ih,
         inner_stash_size=stash_size,
         inner_cipher_rounds=cipher_rounds,
+        inner_top_cache_levels=min(top_cache_levels, ih),
     )
 
 
@@ -155,6 +163,7 @@ def inner_oram_config(spec: PosMapSpec):
         cipher_rounds=spec.inner_cipher_rounds,
         cipher_impl="jnp",
         n_blocks=spec.inner_blocks,
+        top_cache_levels=spec.inner_top_cache_levels,
     )
 
 
@@ -413,7 +422,11 @@ def posmap_private_bytes(cfg) -> int:
     table = 4 * (icfg.blocks + 1)
     stash = 4 * s + 4 * s * k  # stash_idx + stash_val + stash_leaf(0)
     scalars = 4 * (1 + 1 + 8 + 2)  # dummy_entry, overflow, key, epoch
-    return table + stash + scalars
+    # internal tree-top cache planes are decrypted-resident private
+    # state (stash standing), so they count against the private budget
+    z = icfg.bucket_slots
+    cache = icfg.cache_buckets * (4 * z + 4 * z * k)
+    return table + stash + scalars + cache
 
 
 def posmap_hbm_bytes(cfg) -> int:
@@ -458,6 +471,18 @@ def read_table(cfg, pm_state):
     rows = tval.reshape(-1, k)
     flat_idx = tidx.reshape(-1)
     live = flat_idx != int(SENTINEL)
+    # tree-top cache: cached buckets' HBM rows are stale (decrypt to
+    # empty — never written while cached); the authoritative plaintext
+    # rows live in the cache planes
+    ncache = int(np.asarray(inner.cache_idx).size)
+    if ncache:
+        live[:ncache] = False
+        crows = np.asarray(inner.cache_val).reshape(-1, k)
+        cidx = np.asarray(inner.cache_idx)
+        for slot in np.nonzero(cidx != int(SENTINEL))[0]:
+            blk = int(cidx[slot])
+            out[blk * k: (blk + 1) * k] = crows[slot]
+            seen[blk] = True
     for slot in np.nonzero(live)[0]:
         blk = int(flat_idx[slot])
         out[blk * k: (blk + 1) * k] = rows[slot]

@@ -221,7 +221,20 @@ def oram_round(
     cols_flat = jnp.repeat(jnp.arange(b, dtype=U32), plen)
     fowner = bmap[flat_b] == cols_flat
 
-    slot_b = path_slot_indices(cfg, flat_b).reshape(-1)  # [B*plen*z]
+    # tree-top cache split (cfg.top_cache_levels = kc): the top kc
+    # levels of every path resolve against the decrypted-resident cache
+    # planes; ONLY the bottom plen−kc levels touch the encrypted HBM
+    # tree arrays — the round's HBM path traffic and cipher row count
+    # both shrink by kc/plen (the jaxpr audit in
+    # tools/check_tree_cache_oblivious.py pins this). kc=0 degenerates
+    # to the full-path program bit-for-bit.
+    kc = cfg.top_cache_levels
+    nbot = plen - kc
+    bot_b = path_b[:, kc:].reshape(b * nbot)
+    bot_slots = path_slot_indices(cfg, bot_b).reshape(-1)  # [B*nbot*z]
+    top_b = path_b[:, :kc].reshape(b * kc)
+    top_slots = path_slot_indices(cfg, top_b).reshape(-1)  # [B*kc*z]
+
     fused = cfg.cipher_impl in ("pallas_fused", "pallas_fused_tiled")
     with device_phase("oram_fetch"):
         if axis_name is None and fused and cfg.encrypted:
@@ -238,18 +251,29 @@ def oram_round(
                  else gather_decrypt_rows)
             pidx, pval = g(
                 state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
-                flat_b, z=z, rounds=cfg.cipher_rounds,
+                bot_b, z=z, rounds=cfg.cipher_rounds,
                 interpret=jax.default_backend() not in _TPU_BACKENDS,
             )
         else:
-            pidx = _path_gather(state.tree_idx, slot_b, axis_name).reshape(
-                b * plen, z
+            pidx = _path_gather(state.tree_idx, bot_slots, axis_name).reshape(
+                b * nbot, z
             )
-            pval = _path_gather(state.tree_val, flat_b, axis_name)  # [B*plen, z*v]
-            pnonce = _path_gather(state.nonces, flat_b, axis_name)
+            pval = _path_gather(state.tree_val, bot_b, axis_name)  # [B*nbot, z*v]
+            pnonce = _path_gather(state.nonces, bot_b, axis_name)
             pidx, pval = cipher_rows(
-                cfg, state.cipher_key, flat_b, pnonce, pidx, pval
+                cfg, state.cipher_key, bot_b, pnonce, pidx, pval
             )
+        if kc:
+            # cached top levels: plain private gathers, no cipher — the
+            # cache planes are plaintext working state like the stash
+            pidx = jnp.concatenate(
+                [state.cache_idx[top_slots].reshape(b, kc, z),
+                 pidx.reshape(b, nbot, z)], axis=1,
+            ).reshape(b * plen, z)
+            pval = jnp.concatenate(
+                [state.cache_val[top_b].reshape(b, kc, z * v),
+                 pval.reshape(b, nbot, z * v)], axis=1,
+            ).reshape(b * plen, z * v)
         # non-owner copies of shared buckets are invalidated
         pidx = jnp.where(fowner[:, None], pidx, SENTINEL)
         if recursive:
@@ -257,12 +281,18 @@ def oram_round(
             # the fused kernels cover only the idx/val planes
             from .path_oram import leaf_plane_cipher
 
-            pleaf = _path_gather(state.tree_leaf, slot_b, axis_name)
-            pnonce_l = _path_gather(state.nonces, flat_b, axis_name)
+            pleaf = _path_gather(state.tree_leaf, bot_slots, axis_name)
+            pnonce_l = _path_gather(state.nonces, bot_b, axis_name)
             pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, flat_b, pnonce_l,
-                pleaf.reshape(b * plen, z),
-            ).reshape(-1)
+                cfg, state.cipher_key, bot_b, pnonce_l,
+                pleaf.reshape(b * nbot, z),
+            )
+            if kc:
+                pleaf = jnp.concatenate(
+                    [state.cache_leaf[top_slots].reshape(b, kc, z),
+                     pleaf.reshape(b, nbot, z)], axis=1,
+                )
+            pleaf = pleaf.reshape(-1)
 
     w = s + nslots + b  # + b reserved rows for net inserts
     widx0 = jnp.concatenate(
@@ -414,9 +444,16 @@ def oram_round(
         stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
 
     # owner expansion for the flat slot axis: each of a bucket's z slots
-    # shares the bucket's owner bit
-    fowner_slots = jnp.repeat(fowner, z)
-    epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * plen, 2))
+    # shares the bucket's owner bit; the eviction output new_pidx/new_pval
+    # is [col, level, slot]-ordered, so the top-kc/bottom split is a
+    # contiguous reshape per column
+    fowner_bot = fowner.reshape(b, plen)[:, kc:].reshape(b * nbot)
+    fowner_bot_slots = jnp.repeat(fowner_bot, z)
+    bot_pidx = new_pidx.reshape(b, plen, z)[:, kc:].reshape(b * nbot, z)
+    bot_pval = new_pval.reshape(b, plen, z * v)[:, kc:].reshape(
+        b * nbot, z * v
+    )
+    epochs_w = jnp.broadcast_to(state.epoch[None, :], (b * nbot, 2))
     with device_phase("oram_writeback"):
         if axis_name is None and fused and cfg.encrypted:
             # single-chip fast path: encrypt + scatter in ONE HBM pass (the
@@ -433,9 +470,8 @@ def oram_round(
                   else scatter_encrypt_rows)
             tree_idx_new, tree_val_new, nonces = sc(
                 state.cipher_key, state.tree_idx, state.tree_val, state.nonces,
-                flat_b, fowner, state.epoch,
-                new_pidx.reshape(b * plen, z),
-                new_pval.reshape(b * plen, z * v),
+                bot_b, fowner_bot, state.epoch,
+                bot_pidx, bot_pval,
                 z=z, rounds=cfg.cipher_rounds,
                 interpret=jax.default_backend() not in _TPU_BACKENDS,
             )
@@ -443,39 +479,74 @@ def oram_round(
             enc_pidx, enc_pval = cipher_rows(
                 cfg,
                 state.cipher_key,
-                flat_b,
+                bot_b,
                 epochs_w,
-                new_pidx.reshape(b * plen, z),
-                new_pval.reshape(b * plen, z * v),
+                bot_pidx,
+                bot_pval,
             )
             tree_idx_new = _path_scatter(
-                state.tree_idx, slot_b, enc_pidx.reshape(-1), axis_name,
-                fowner_slots,
+                state.tree_idx, bot_slots, enc_pidx.reshape(-1), axis_name,
+                fowner_bot_slots,
             )
             tree_val_new = _path_scatter(
-                state.tree_val, flat_b, enc_pval, axis_name, fowner
+                state.tree_val, bot_b, enc_pval, axis_name, fowner_bot
             )
             nonces = (
-                _path_scatter(state.nonces, flat_b, epochs_w, axis_name, fowner)
+                _path_scatter(
+                    state.nonces, bot_b, epochs_w, axis_name, fowner_bot
+                )
                 if cfg.encrypted
                 else state.nonces
             )
+        if kc:
+            # cached levels write back plaintext, owner-masked exactly
+            # like the tree scatters (one owning column per bucket ⇒
+            # unique in-bounds targets); replicated private state, so no
+            # collective even under sharding — every chip writes the
+            # identical values (the stash-recompaction standing)
+            fowner_top = fowner.reshape(b, plen)[:, :kc].reshape(b * kc)
+            cache_idx_new = _path_scatter(
+                state.cache_idx, top_slots,
+                new_pidx.reshape(b, plen, z)[:, :kc].reshape(-1), None,
+                jnp.repeat(fowner_top, z),
+            )
+            cache_val_new = _path_scatter(
+                state.cache_val, top_b,
+                new_pval.reshape(b, plen, z * v)[:, :kc].reshape(
+                    b * kc, z * v
+                ),
+                None, fowner_top,
+            )
+        else:
+            cache_idx_new = state.cache_idx
+            cache_val_new = state.cache_val
+        cache_leaf_new = state.cache_leaf
         if recursive:
             from .path_oram import leaf_plane_cipher
 
+            pleaf3 = new_pleaf.reshape(b, plen, z)
             enc_pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, flat_b, epochs_w,
-                new_pleaf.reshape(b * plen, z),
+                cfg, state.cipher_key, bot_b, epochs_w,
+                pleaf3[:, kc:].reshape(b * nbot, z),
             )
             tree_leaf_new = _path_scatter(
-                state.tree_leaf, slot_b, enc_pleaf.reshape(-1), axis_name,
-                fowner_slots,
+                state.tree_leaf, bot_slots, enc_pleaf.reshape(-1), axis_name,
+                fowner_bot_slots,
             )
+            if kc:
+                cache_leaf_new = _path_scatter(
+                    state.cache_leaf, top_slots,
+                    pleaf3[:, :kc].reshape(-1), None,
+                    jnp.repeat(fowner_top, z),
+                )
         else:
             tree_leaf_new = state.tree_leaf
     new_state = OramState(
         tree_idx=tree_idx_new,
         tree_val=tree_val_new,
+        cache_idx=cache_idx_new,
+        cache_val=cache_val_new,
+        cache_leaf=cache_leaf_new,
         tree_leaf=tree_leaf_new,
         stash_idx=stash_idx,
         stash_val=stash_val,

@@ -64,6 +64,13 @@ def _oram_specs() -> OramState:
     return OramState(
         tree_idx=P(TREE_AXIS),
         tree_val=P(TREE_AXIS),
+        # tree-top cache planes: replicated private state (stash
+        # standing) — every chip reads and writes the identical values,
+        # so cache accesses need no collective (2^k−1 buckets is KBs,
+        # not the GBs the sharded trees are)
+        cache_idx=P(),
+        cache_val=P(),
+        cache_leaf=P(),
         # leaf-metadata plane (recursive posmap): sharded like tree_idx;
         # zero-length under a flat map (every shard is empty — valid)
         tree_leaf=P(TREE_AXIS),

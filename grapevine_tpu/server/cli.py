@@ -74,6 +74,21 @@ def build_parser() -> argparse.ArgumentParser:
         "Device-owning roles only — the frontend never touches a "
         "position map",
     )
+    p.add_argument(
+        "--tree-top-cache-levels",
+        type=int,
+        default=None,
+        help="tree-top cache depth k for every Path-ORAM bucket tree "
+        "(oram/path_oram.py): the top k levels (2^k-1 buckets, on "
+        "EVERY path) live decrypted-resident instead of in the "
+        "encrypted HBM tree, cutting per-access path HBM traffic and "
+        "cipher work to the bottom height+1-k levels. "
+        "Access-pattern-neutral (the cached levels are touched by "
+        "every access; CI-audited) and bit-identical at every k. "
+        "0 = off; unset = auto per backend (OPERATIONS.md §14 sizing "
+        "+ flip guidance). Device-owning roles only — the frontend "
+        "never touches a tree",
+    )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument(
         "--identity-seed",
@@ -266,9 +281,10 @@ _TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
                     "profile_enable"}
 
 #: device-engine geometry knobs: only roles that build an engine take
-#: them — a frontend supplying --posmap-impl would silently configure
-#: nothing (its engine lives in another process)
-_ENGINE_GEOM_FLAGS = {"posmap_impl"}
+#: them — a frontend supplying --posmap-impl or --tree-top-cache-levels
+#: would silently configure nothing (its engine lives in another
+#: process)
+_ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels"}
 
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
@@ -392,6 +408,7 @@ def main(argv=None) -> int:
         expiry_period=args.expiry_period,
         batch_size=args.batch_size,
         posmap_impl=args.posmap_impl,
+        tree_top_cache_levels=args.tree_top_cache_levels,
     )
     identity = None
     if args.identity_seed:

@@ -7,7 +7,12 @@ import numpy as np
 
 from ..config import TPU_BACKENDS
 
-__all__ = ["states_equal_excluding_junk", "TPU_BACKENDS"]
+__all__ = [
+    "states_equal_excluding_junk",
+    "logical_tree_planes",
+    "assert_logical_state_equal",
+    "TPU_BACKENDS",
+]
 
 
 def states_equal_excluding_junk(sa, sb):
@@ -44,3 +49,110 @@ def states_equal_excluding_junk(sa, sb):
         if not np.array_equal(x, y):
             return False, key
     return True, None
+
+
+def logical_tree_planes(cfg, oram):
+    """Decrypted logical content of one ORAM's bucket tree, with the
+    tree-top cache overlaid (host-side; never on the round path).
+
+    Returns ``(idx [n, Z], val [n, Z*V], leaf [n, Z] | None)`` plaintext
+    planes. Under ``cfg.top_cache_levels = k > 0`` the top 2^k−1
+    buckets' HBM rows are stale (empty-at-init ciphertext, re-keyed but
+    never read) and the authoritative plaintext lives in the cache
+    planes — so rows [0, 2^k−1) are taken from the cache. This is the
+    canonical form the cached↔uncached bit-identity contract compares:
+    two states are equal iff their logical planes, stashes, maps, and
+    scalars are equal (ciphertext at cached levels legitimately
+    diverges — the cached run never re-encrypts them).
+    """
+    from ..oblivious.bucket_cipher import row_keystream
+    import jax.numpy as jnp
+
+    z, v = cfg.bucket_slots, cfg.value_words
+    n = cfg.n_buckets_padded
+    idx = np.asarray(oram.tree_idx).reshape(n, z).copy()
+    val = np.asarray(oram.tree_val).copy()
+    leaf = (
+        np.asarray(oram.tree_leaf).reshape(n, z).copy()
+        if np.asarray(oram.tree_leaf).size
+        else None
+    )
+    if cfg.encrypted:
+        buckets = jnp.arange(n, dtype=jnp.uint32)
+        ks = np.asarray(
+            row_keystream(
+                oram.cipher_key, buckets, oram.nonces, cfg.row_words,
+                cfg.cipher_rounds,
+            )
+        )
+        idx ^= ks[:, :z]
+        val ^= ks[:, z:]
+        if leaf is not None:
+            ksl = np.asarray(
+                row_keystream(
+                    oram.cipher_key, buckets + jnp.uint32(n), oram.nonces,
+                    z, cfg.cipher_rounds,
+                )
+            )
+            leaf ^= ksl
+    cb = cfg.cache_buckets
+    if cb:
+        idx[:cb] = np.asarray(oram.cache_idx).reshape(cb, z)
+        val[:cb] = np.asarray(oram.cache_val)
+        if leaf is not None:
+            leaf[:cb] = np.asarray(oram.cache_leaf).reshape(cb, z)
+    return idx, val, leaf
+
+
+def assert_logical_state_equal(ecfg_a, sa, ecfg_b, sb, ctx=""):
+    """Cached↔uncached final-state contract: every logical plane, stash,
+    position map, and scalar equal — the tree-cache analog of PR 7's
+    payload-state bit-equality (which cache-level ciphertext divergence
+    makes too strict to apply raw). Works across differing
+    ``top_cache_levels`` and across flat/recursive posmaps (inner trees
+    compared logically too, via their own planes)."""
+    from ..oram.posmap import inner_oram_config
+
+    for tree in ("rec", "mb"):
+        ca, cb_ = getattr(ecfg_a, tree), getattr(ecfg_b, tree)
+        oa, ob = getattr(sa, tree), getattr(sb, tree)
+        pa = logical_tree_planes(ca, oa)
+        pb = logical_tree_planes(cb_, ob)
+        for name, x, y in zip(("idx", "val", "leaf"), pa, pb):
+            if x is None and y is None:
+                continue
+            # mask the padded junk bucket (states_equal_excluding_junk)
+            assert np.array_equal(x[:-1], y[:-1]), (
+                f"{ctx}: {tree} logical {name} plane diverges"
+            )
+        for f in ("stash_idx", "stash_val", "stash_leaf", "overflow",
+                  "epoch", "cipher_key"):
+            assert np.array_equal(
+                np.asarray(getattr(oa, f)), np.asarray(getattr(ob, f))
+            ), f"{ctx}: {tree}.{f} diverges"
+        if ca.posmap is None:
+            assert np.array_equal(
+                np.asarray(oa.posmap), np.asarray(ob.posmap)
+            ), f"{ctx}: {tree} flat posmap diverges"
+        else:
+            ia, ib = inner_oram_config(ca.posmap), inner_oram_config(cb_.posmap)
+            qa = logical_tree_planes(ia, oa.posmap.inner)
+            qb = logical_tree_planes(ib, ob.posmap.inner)
+            for name, x, y in zip(("idx", "val"), qa[:2], qb[:2]):
+                assert np.array_equal(x[:-1], y[:-1]), (
+                    f"{ctx}: {tree} inner posmap logical {name} diverges"
+                )
+            for f in ("stash_idx", "stash_val", "posmap", "overflow"):
+                assert np.array_equal(
+                    np.asarray(getattr(oa.posmap.inner, f)),
+                    np.asarray(getattr(ob.posmap.inner, f)),
+                ), f"{ctx}: {tree} inner posmap {f} diverges"
+            assert np.array_equal(
+                np.asarray(oa.posmap.dummy_entry),
+                np.asarray(ob.posmap.dummy_entry),
+            ), f"{ctx}: {tree} posmap dummy_entry diverges"
+    for f in ("freelist", "free_top", "recipients", "seq", "hash_key",
+              "id_key", "rng"):
+        assert np.array_equal(
+            np.asarray(getattr(sa, f)), np.asarray(getattr(sb, f))
+        ), f"{ctx}: {f} diverges"

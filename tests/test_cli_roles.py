@@ -33,6 +33,10 @@ def _check(argv):
     # --posmap-impl would silently configure nothing (ISSUE 7 satellite)
     ["--role", "frontend", "--posmap-impl", "recursive"],
     ["--role", "frontend", "--posmap-impl", "flat"],
+    # same for the tree-top cache depth (ISSUE 8 satellite) — rejected
+    # even at the explicit "off" value
+    ["--role", "frontend", "--tree-top-cache-levels", "4"],
+    ["--role", "frontend", "--tree-top-cache-levels", "0"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -63,6 +67,10 @@ def test_misapplied_flags_rejected(argv):
     ["--role", "mono", "--posmap-impl", "recursive"],
     ["--role", "engine", "--engine-listen", "127.0.0.1:0",
      "--posmap-impl", "flat"],
+    # …and the tree-top cache depth (ISSUE 8)
+    ["--role", "mono", "--tree-top-cache-levels", "4"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--tree-top-cache-levels", "0"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

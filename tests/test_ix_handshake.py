@@ -8,6 +8,13 @@ static is rejected before any frame flows.
 """
 
 import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="session channel layer needs the cryptography wheel "
+    "(absent in some CI containers) — skip, not a collection error",
+)
+
 from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
 
 from grapevine_tpu.session import channel

@@ -3,6 +3,12 @@ channel handshake + framing, challenge lockstep."""
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="session channel layer needs the cryptography wheel "
+    "(absent in some CI containers) — skip, not a collection error",
+)
+
 from grapevine_tpu.session import chacha, channel, ristretto
 from grapevine_tpu.wire import constants as C
 

@@ -48,13 +48,15 @@ SWEEP_PERIOD = 10_000
 MAX_RESTARTS = 60
 
 
-def _config(posmap_impl: str | None = None):
+def _config(posmap_impl: str | None = None,
+            tree_top_cache_levels: int | None = None):
     from grapevine_tpu.config import GrapevineConfig
 
     return GrapevineConfig(
         max_messages=64, max_recipients=8, mailbox_cap=4,
         batch_size=4, stash_size=64, bucket_cipher_rounds=0,
         posmap_impl=posmap_impl,
+        tree_top_cache_levels=tree_top_cache_levels,
     )
 
 
@@ -130,7 +132,8 @@ def run_child(args) -> int:
         journal_fsync_every=1,
     )
     engine = GrapevineEngine(
-        _config(args.posmap_impl), seed=ENGINE_SEED, durability=dcfg
+        _config(args.posmap_impl, args.tree_top_cache_levels),
+        seed=ENGINE_SEED, durability=dcfg,
     )
     monitor = EngineLeakMonitor.for_engine(
         engine, LeakMonitorConfig(window_rounds=64)
@@ -163,12 +166,15 @@ def run_child(args) -> int:
     return 0
 
 
-def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None):
+def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None,
+           tree_top_cache_levels: int | None = None):
     """Uninterrupted in-process run: per-seq hashes + final state hash."""
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.engine.checkpoint import state_to_bytes
 
-    engine = GrapevineEngine(_config(posmap_impl), seed=ENGINE_SEED)
+    engine = GrapevineEngine(
+        _config(posmap_impl, tree_top_cache_levels), seed=ENGINE_SEED
+    )
     events = build_schedule(schedule_seed, n_events)
     hashes: dict[int, str] = {}
     for i, ev in enumerate(events):
@@ -220,6 +226,9 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
         ]
         if args.posmap_impl:
             child_cmd += ["--posmap-impl", args.posmap_impl]
+        if args.tree_top_cache_levels is not None:
+            child_cmd += ["--tree-top-cache-levels",
+                          str(args.tree_top_cache_levels)]
         base_env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
@@ -312,7 +321,8 @@ def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
     os.makedirs(cache_dir, exist_ok=True)
     t0 = time.monotonic()
     oracle_hashes, oracle_final = oracle(
-        args.schedule_seed, args.events, args.posmap_impl
+        args.schedule_seed, args.events, args.posmap_impl,
+        args.tree_top_cache_levels,
     )
     print(f"oracle: {len(oracle_hashes)} events in "
           f"{time.monotonic() - t0:.1f}s", flush=True)
@@ -347,6 +357,9 @@ def parse_args(argv):
                    choices=["flat", "recursive"],
                    help="position-map implementation under test "
                    "(oram/posmap.py); default = the engine auto (flat)")
+    p.add_argument("--tree-top-cache-levels", type=int, default=None,
+                   help="tree-top cache depth under test "
+                   "(oram/path_oram.py); default = the engine auto")
     return p.parse_args(argv)
 
 

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""CI gate: the tree-top-cached round is index-blind AND actually cuts
+the per-access HBM path traffic to the bottom path_len−k levels.
+
+Two claims, both jaxpr-level (the PR-3/5/7 audit pattern — trace-time
+facts, not runtime sampling):
+
+1. **Index-independence.** Trace ``oram_round`` with the batch indices
+   baked in as concrete constants for adversarially different index
+   sets (all distinct, all identical, all dummy, mixed duplicates) and
+   assert the full primitive census is IDENTICAL across them, with no
+   data-dependent control flow anywhere. The tree-top cache moves the
+   top k levels into private cache planes — this proves the move never
+   introduces an index-dependent shortcut (e.g. skipping the cache
+   concat for dummy batches).
+
+2. **HBM row-count accounting.** Every gather/scatter whose operand is
+   one of the big HBM tree planes (``tree_idx`` u32[n·Z], ``tree_val``
+   u32[n, Z·V], ``nonces`` u32[n, 2], ``tree_leaf`` u32[n·Z]) must move
+   exactly ``B·(path_len−k)`` bucket rows (``·Z`` slots for the flat
+   slot planes) — i.e. per access, exactly ``path_len−k`` bucket rows
+   per plane, the ISSUE-8 acceptance number. ``k=0`` is the positive
+   control: the same census shows the full ``path_len`` rows, proving
+   the counter sees the traffic it claims to cut. The cache planes must
+   appear in the census at ``k>0`` (the top levels are really served
+   from the cache) and must be absent at ``k=0``.
+
+Wired into tier-1 via tests/test_tree_cache.py; standalone:
+``python tools/check_tree_cache_oblivious.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_ACCESS_PRIMS = ("gather", "scatter", "scatter-add", "scatter-min",
+                 "dynamic_slice", "dynamic_update_slice")
+_CONTROL_PRIMS = ("cond", "while")
+
+
+def _walk(jaxpr):
+    """Yield every equation, recursing into sub-jaxprs."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vs:
+                if hasattr(x, "eqns") or hasattr(x, "jaxpr"):
+                    yield from _walk(x)
+
+
+def _census(jaxpr) -> Counter:
+    return Counter(eqn.primitive.name for eqn in _walk(jaxpr))
+
+
+def _index_sets(cfg, b: int):
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    return {
+        "distinct": (np.arange(b) % cfg.blocks).astype(np.uint32),
+        "all_same": np.zeros(b, np.uint32),
+        "all_dummy": np.full(b, cfg.dummy_index, np.uint32),
+        "mixed_dups": rng.integers(0, cfg.blocks + 1, b).astype(np.uint32),
+    }
+
+
+def _trace_round(cfg, idxs, b: int):
+    """Jaxpr of one whole ORAM round with ``idxs`` concrete constants."""
+    import jax
+    import jax.numpy as jnp
+
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.oram.round import oram_round
+
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    cidxs = jnp.asarray(idxs)
+    recursive = cfg.posmap is not None
+
+    def apply_batch(vals0, present0):
+        return jnp.sum(vals0, axis=1), vals0, present0
+
+    u32 = jnp.uint32
+    lf = jax.ShapeDtypeStruct((b,), u32)
+
+    def run(st, nl, dl, pm_nl, pm_dl):
+        return oram_round(
+            cfg, st, cidxs, nl, dl, apply_batch,
+            pm_new_leaves=pm_nl if recursive else None,
+            pm_dummy_leaves=pm_dl if recursive else None,
+        )
+
+    return jax.make_jaxpr(run)(state, lf, lf, lf, lf)
+
+
+def _plane_rows(jaxpr, cfg) -> dict:
+    """Rows moved per HBM tree plane (and cache plane) by every
+    gather/scatter in the traced round, keyed by plane name. A gather's
+    row count is its output leading dim; a scatter's is its updates
+    leading dim; flat slot planes report slots/Z."""
+    z, v = cfg.bucket_slots, cfg.value_words
+    n = cfg.n_buckets_padded
+    cb = cfg.cache_buckets
+    planes = {
+        "tree_idx": ((n * z,), z),
+        "tree_val": ((n, z * v), 1),
+        "nonces": ((n, 2), 1),
+    }
+    if cfg.posmap is not None:
+        planes["tree_leaf"] = ((n * z,), z)
+    cplanes = {}
+    if cb:
+        cplanes = {
+            "cache_idx": ((cb * z,), z),
+            "cache_val": ((cb, z * v), 1),
+        }
+        if cfg.posmap is not None:
+            cplanes["cache_leaf"] = ((cb * z,), z)
+    out: dict[str, list] = {k: [] for k in {**planes, **cplanes}}
+    for eqn in _walk(jaxpr):
+        name = eqn.primitive.name
+        if not name.startswith("scatter") and name != "gather":
+            continue
+        op_shape = tuple(eqn.invars[0].aval.shape)
+        moved = (
+            eqn.outvars[0].aval.shape
+            if name == "gather"
+            else eqn.invars[2].aval.shape
+        )
+        for pname, (pshape, div) in {**planes, **cplanes}.items():
+            if op_shape == pshape:
+                rows = (moved[0] if moved else 0) // div
+                out[pname].append((name, rows))
+    return out
+
+
+def check_tree_cache_schedule(
+    b: int = 8, height: int = 5, verbose: bool = False, recursive: bool = False
+) -> dict:
+    """Run both audits over k ∈ {0, 2}; raises AssertionError on any
+    violation, returns the per-k row accounting."""
+    from grapevine_tpu.oram.path_oram import OramConfig
+    from grapevine_tpu.oram.posmap import derive_posmap_spec
+
+    out = {}
+    for k in (0, 2):
+        pm = (
+            derive_posmap_spec(1 << height, top_cache_levels=k)
+            if recursive
+            else None
+        )
+        cfg = OramConfig(
+            height=height, value_words=8, n_blocks=1 << height,
+            cipher_rounds=8, top_cache_levels=k, posmap=pm,
+        )
+        plen = cfg.path_len
+        want = b * (plen - k)
+
+        # -- 1. index-independence ---------------------------------------
+        censuses = {
+            iname: _census(_trace_round(cfg, idxs, b))
+            for iname, idxs in _index_sets(cfg, b).items()
+        }
+        base_name, base = next(iter(censuses.items()))
+        for iname, c in censuses.items():
+            assert c == base, (
+                f"k={k}: cached round traces a DIFFERENT program for "
+                f"index set {iname!r} vs {base_name!r}: "
+                f"{(c - base) + (base - c)} — the access schedule "
+                "depends on the queried indices"
+            )
+        n_control = sum(base[p] for p in _CONTROL_PRIMS)
+        assert n_control == 0, (
+            f"k={k}: data-dependent control flow in the round "
+            f"({ {p: base[p] for p in _CONTROL_PRIMS if base[p]} })"
+        )
+
+        # -- 2. HBM row accounting ---------------------------------------
+        rows = _plane_rows(_trace_round(cfg, _index_sets(cfg, b)["mixed_dups"], b), cfg)
+        for pname in ("tree_idx", "tree_val", "nonces"):
+            moved = rows[pname]
+            assert moved, f"k={k}: no accesses seen on {pname}"
+            bad = [r for _, r in moved if r != want]
+            assert not bad, (
+                f"k={k}: {pname} moves {sorted(set(bad))} bucket rows "
+                f"per round — every HBM path access must move exactly "
+                f"B·(path_len−k) = {b}·({plen}−{k}) = {want}"
+            )
+        if recursive:
+            assert rows["tree_leaf"], f"k={k}: no tree_leaf accesses"
+            assert all(r == want for _, r in rows["tree_leaf"]), (
+                f"k={k}: tree_leaf rows diverge from {want}"
+            )
+        if k:
+            for pname in ("cache_idx", "cache_val"):
+                assert rows[pname], (
+                    f"k={k}: the cache plane {pname} is never accessed — "
+                    "the cached levels are not actually served from the "
+                    "cache"
+                )
+                assert all(r == b * k for _, r in rows[pname]), (
+                    f"k={k}: {pname} moves {rows[pname]} — want B·k = "
+                    f"{b * k} rows"
+                )
+        out[f"k{k}"] = {
+            p: sorted({r for _, r in rs}) for p, rs in rows.items() if rs
+        }
+        if verbose:
+            print(f"k={k} ({'recursive' if recursive else 'flat'}): "
+                  f"{out[f'k{k}']}")
+
+    # positive control across k: the counter must SEE the cut
+    full = out["k0"]["tree_val"][0]
+    cut = out["k2"]["tree_val"][0]
+    assert full == b * (height + 1) and cut == b * (height - 1), (
+        f"positive control failed: k=0 moves {full} rows, k=2 moves "
+        f"{cut} — expected {b * (height + 1)} vs {b * (height - 1)}"
+    )
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--height", type=int, default=5)
+    args = ap.parse_args(argv)
+    for recursive in (False, True):
+        out = check_tree_cache_schedule(
+            b=args.batch, height=args.height, verbose=True,
+            recursive=recursive,
+        )
+        print(f"[check_tree_cache_oblivious] recursive={recursive}: OK {out}")
+    print("[check_tree_cache_oblivious] PASS: cached round is index-blind "
+          "and HBM path traffic is exactly B·(path_len−k) rows per plane")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

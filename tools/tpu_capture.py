@@ -22,6 +22,9 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
 4d. posmap_perf — flat vs recursive position map A/B (prices the
                  recursive map's whole-round overhead on a real chip —
                  the capacity knob's cost side, OPERATIONS.md §13)
+4e. tree_cache_perf — tree-top cache k-sweep (the on-chip decision
+                 number for the tree_top_cache_levels auto default,
+                 jnp + fused-Pallas pairs; OPERATIONS.md §14)
 5. oblivious   — transcript equality + R/U/D timing z-scores from
                  TPU-executed rounds (tiny capacity; it is the compiled
                  schedule being tested, not scale)
@@ -95,13 +98,13 @@ def stage_probe(cap, args):
 
 
 def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
-              vphases=None, sort=None, posmap=None):
+              vphases=None, sort=None, posmap=None, tree_cache=None):
     """zipf_mixed through a chosen cipher impl at a chosen size, using
     bench.py's own machinery (same methodology as the driver bench).
     ``vphases`` selects the slot-order machinery ("dense"/"scan"),
     ``sort`` the bounded-key sort engine ("xla"/"radix"), ``posmap``
-    the position map ("flat"/"recursive"); None = the backend default
-    for each."""
+    the position map ("flat"/"recursive"), ``tree_cache`` the tree-top
+    cache depth (int; 0 = off); None = the backend default for each."""
     import jax
     import numpy as np
 
@@ -111,6 +114,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     cfg, ecfg, state, step = bench._mk_engine(
         1 << cap_log2, 1 << max(8, cap_log2 - 8), batch, cipher_impl=impl,
         vphases_impl=vphases, sort_impl=sort, posmap_impl=posmap,
+        tree_top_cache=tree_cache,
     )
     batches = bench.make_batches(4, batch)
     compile_t0 = time.perf_counter()
@@ -121,6 +125,7 @@ def _zipf_run(cap, stage_name, impl, cap_log2, batch, n_rounds,
     ops = batch * n_rounds
     cap.emit(stage_name, impl=impl, vphases=ecfg.vphases_impl,
              sort=ecfg.sort_impl, posmap=ecfg.posmap_impl,
+             tree_cache=ecfg.tree_top_cache_levels,
              capacity_log2=cap_log2, batch=batch,
              rounds=n_rounds, ops_per_sec=round(ops / total, 1),
              p99_round_ms=round(bench._p99(times), 2),
@@ -355,6 +360,38 @@ def stage_posmap_perf(cap, args):
         cap.emit("posmap_perf", machinery=bench.bench_posmap_ab(smoke=False))
 
 
+def stage_tree_cache_perf(cap, args):
+    """Tree-top cache k-sweep ON TPU — the real-chip decision number
+    for the ``tree_top_cache_levels`` auto default (config.py; auto = 4
+    everywhere on the strict-subtraction argument — the cache removes
+    HBM gather/scatter rows and cipher work, it never trades
+    algorithms — with the CPU A/B banked in PERF.md Round 10). Mirrors
+    ``posmap_perf``: identical workload, k the only knob, bit-identical
+    logical state at every k (tests/test_tree_cache.py) so the faster
+    k simply wins; this stage prices where the TPU curve flattens
+    (deeper k caches levels fewer paths share — diminishing rows cut
+    per byte pinned). Pairs at headline geometry plus the isolated
+    ORAM-round machinery grid from bench ``tree_cache_ab``."""
+    cl, b = (16, 256) if args.quick else (20, 2048)
+    _zipf_run(cap, "tree_cache_perf", "jnp", cl, b, 8, tree_cache=0)
+    _zipf_run(cap, "tree_cache_perf", "jnp", cl, b, 8, tree_cache=4)
+    if not args.quick:
+        _zipf_run(cap, "tree_cache_perf", "jnp", cl, b, 8, tree_cache=2)
+        _zipf_run(cap, "tree_cache_perf", "jnp", cl, b, 8, tree_cache=8)
+        # the cache composes with the fused Pallas path (the TPU
+        # production cipher): one fused pair proves the composed fast
+        # path and prices it
+        _zipf_run(cap, "tree_cache_perf", "pallas_fused_tiled", cl, b, 8,
+                  tree_cache=0)
+        _zipf_run(cap, "tree_cache_perf", "pallas_fused_tiled", cl, b, 8,
+                  tree_cache=4)
+        # isolated machinery grid — path traffic priced alone
+        import bench
+
+        cap.emit("tree_cache_perf",
+                 machinery=bench.bench_tree_cache_ab(smoke=False))
+
+
 def stage_oblivious(cap, args):
     """SURVEY §7 hard-part 2 on the real device: R/U/D transcript
     equality + timing uniformity, reusing the CPU suite's EXACT
@@ -571,6 +608,7 @@ STAGES = [
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
     ("posmap_perf", stage_posmap_perf, 1800),
+    ("tree_cache_perf", stage_tree_cache_perf, 1800),
     ("oblivious", stage_oblivious, 900),
     ("fullbench", None, 2400),  # subprocess-only (see main loop)
 ]
