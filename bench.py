@@ -34,6 +34,14 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
                          PR6): enqueue→settle latency, burn rates, and
                          the host/device bubble ratio — runs everywhere
                          (no session crypto in the loop)
+  8. load_scenarios      the workload observatory (PR9): open-loop
+                         scenario suite (steady/bursty/diurnal/
+                         pop-heavy/adversarial/ramp) through the
+                         scheduler with workload telemetry + leakmon
+                         on — per-scenario commit p50/p99/fill/depth,
+                         adversarial-vs-honest /leakaudit verdicts,
+                         and the ramp's measured saturation knee (the
+                         banked capacity number) — runs everywhere
 
 stdout is ONE JSON line: the headline mixed-CRUD throughput at the
 largest batched config, with every config's (ops/s, p99 round ms)
@@ -1370,6 +1378,154 @@ def bench_slo_loopback(smoke):
         sched.close()
 
 
+def bench_load_scenarios(smoke):
+    """Config 8: the workload observatory (PR9; ROADMAP item 4's
+    measurement half). Open-loop scenario suite through the production
+    BatchScheduler (``submit_nowait`` — overload latency is measured,
+    never self-throttled) with the workload telemetry + leak monitor
+    attached, no session crypto in the loop (the ``slo_loopback``
+    container-portability pattern).
+
+    Rates are calibrated to THIS host: a warm timed round gives the
+    engine's intrinsic capacity estimate, honest scenarios offer
+    fractions of it, and the ramp staircases past it — so the same
+    config saturates a 2-vCPU sandbox and a real TPU without hand
+    tuning. The knee SLO target is ``max(250 ms, 8× the unloaded round
+    time)``: the capacity question is where latency departs from the
+    unloaded baseline (OPERATIONS.md §15 has the methodology).
+
+    Hard acceptance rides inside the config (ISSUE 9): the adversarial
+    probe campaign (+ the red-team leak injector — an honest engine's
+    transcript cannot be flipped by traffic shape alone, which is the
+    point of the FP gate) must end SUSPECT and every honest scenario
+    PASS, else this config errors and ``--smoke`` fails rc!=0."""
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ProbeCampaignInjector,
+        ScenarioRunner,
+        adversarial_probe,
+        analyze_ramp,
+        bursty_onoff,
+        calibrate_unloaded_round,
+        diurnal_sinusoid,
+        pop_heavy_drain,
+        ramp_to_saturation,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs.leakmon import EngineLeakMonitor
+    from grapevine_tpu.obs.workload import WorkloadTelemetry
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    cap, batch, dur = (1 << 10, 4, 1.5) if smoke else (1 << 14, 16, 3.0)
+    cfg = GrapevineConfig(
+        max_messages=cap, max_recipients=1 << 10, batch_size=batch,
+        bucket_cipher_rounds=0 if smoke else 8,
+    )
+    engine = GrapevineEngine(cfg)
+    wl = WorkloadTelemetry(engine.metrics.registry, batch_size=batch)
+    engine.attach_workload(wl)
+    # warm the jit + measure the unloaded round; est scales every
+    # scenario to this host and target_ms is the knee SLO (the shared
+    # formula — load/harness.py calibrate_unloaded_round)
+    t_round, est, target_ms = calibrate_unloaded_round(engine, NOW)
+
+    # --- the scenario suite, rates relative to the calibrated est -----
+    pulse = max(2.0 * t_round, 0.02)
+    n_steps = 4 if smoke else 5
+    # ramp steps must dwarf the commit latency (itself a couple of
+    # rounds): with steps shorter than the backlog's time constant, a
+    # past-capacity step ends before its own arrivals' waits blow up
+    # and the knee reads as "unsaturated" at an offered rate the
+    # engine never sustained
+    step_s = max(0.75, dur / 3.0, 12.0 * t_round)
+    schedules = {
+        "steady": steady_poisson(0.5 * est, dur, seed=11),
+        "bursty": bursty_onoff(
+            1.2 * est, duty=0.4, period_s=dur / 3.0, duration_s=dur,
+            seed=12),
+        "diurnal": diurnal_sinusoid(
+            0.5 * est, rel_amplitude=0.8, period_s=dur / 2.0,
+            duration_s=dur, seed=13),
+        "pop_heavy": pop_heavy_drain(0.5 * est, dur, seed=14, n_hot=4),
+        "adversarial": adversarial_probe(
+            pulse, dur, seed=15, n_probe_keys=4, probes_per_pulse=2),
+        "ramp": ramp_to_saturation(
+            0.25 * est, factor=2.0, n_steps=n_steps, step_s=step_s,
+            seed=16),
+    }
+    honest = ("steady", "bursty", "diurnal", "pop_heavy")
+    out = {
+        "scenarios": {},
+        "calibrated_round_ms": round(t_round * 1e3, 2),
+        # NOT named slo_target_ms: that is a GEOMETRY key for the perf
+        # sentinel, and this value is perf_counter-calibrated — as
+        # geometry it would make every run a fresh series and the
+        # capacity numbers would never be gated at all
+        "knee_target_ms": round(target_ms, 1),
+        "batch": batch, "capacity_log2": cap.bit_length() - 1,
+    }
+    for name, schedule in schedules.items():
+        # fresh monitor per scenario (registry=None: the engine registry
+        # already carries the serving leakmon families; per-scenario
+        # verdicts need fresh windows, not fresh gauges)
+        mon = EngineLeakMonitor(
+            mb_leaves=engine.ecfg.mb.leaves,
+            rec_leaves=engine.ecfg.rec.leaves,
+            mb_choices=engine.ecfg.mb_choices,
+        )
+        sink = (
+            ProbeCampaignInjector(mon, engine.ecfg)
+            if name == "adversarial" else mon
+        )
+        engine.attach_leakmon(sink)
+        sched = BatchScheduler(engine, clock=lambda: NOW)
+        try:
+            runner = ScenarioRunner(sched, n_idents=64,
+                                    settle_timeout_s=120.0)
+            res = runner.run(schedule)
+        finally:
+            sched.close()
+        mon.flush(30)
+        v = mon.verdict()
+        entry = res.summary()
+        entry["leakaudit"] = v["verdict"]
+        entry["leakaudit_rounds"] = v["rounds_observed"]
+        rounds = mon.recorder.dump()["rounds"]
+        if rounds:
+            fills = [r["fill"] for r in rounds]
+            depths = [r.get("queue_depth", 0) for r in rounds]
+            entry["mean_fill"] = round(float(np.mean(fills)), 3)
+            entry["queue_depth_p99"] = float(
+                np.percentile(depths, 99, method="higher"))
+        if name == "ramp":
+            entry.update(analyze_ramp(schedule, res, target_ms))
+            entry["knee_target_ms"] = entry.pop("target_ms")
+        out["scenarios"][name] = entry
+        mon.close()
+        engine.attach_leakmon(None)
+        print(f"[bench]   load_scenarios/{name}: "
+              f"{entry.get('achieved_ops_per_sec')} ops/s, "
+              f"p99 {entry.get('p99_commit_ms')} ms, "
+              f"{entry['leakaudit']}", file=sys.stderr, flush=True)
+
+    # ISSUE 9 acceptance, enforced in the config itself
+    adv = out["scenarios"]["adversarial"]
+    assert adv["leakaudit"] == "SUSPECT" and adv["leakaudit_rounds"] > 0, (
+        f"probe campaign did not flip /leakaudit: {adv}"
+    )
+    for name in honest:
+        h = out["scenarios"][name]
+        assert h["leakaudit"] == "PASS" and h["leakaudit_rounds"] > 0, (
+            f"honest scenario {name} not PASS: {h}"
+        )
+    assert out["scenarios"]["ramp"]["knee_ops_per_sec"] > 0, (
+        f"ramp found no holding step: {out['scenarios']['ramp']}"
+    )
+    out["knee_ops_per_sec"] = out["scenarios"]["ramp"]["knee_ops_per_sec"]
+    return out
+
+
 # Headline config FIRST: if the run later hits a budget wall or the
 # driver's own timeout, the metric that matters is already captured
 # (VERDICT r3, next-round #1b).
@@ -1389,6 +1545,7 @@ CONFIGS = [
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),
     ("slo_loopback", bench_slo_loopback),
+    ("load_scenarios", bench_load_scenarios),
 ]
 
 

@@ -121,7 +121,7 @@ class PendingRound:
     """Handle to a dispatched-but-unsynced round; ``resolve()`` blocks."""
 
     __slots__ = ("_engine", "_resp", "_n", "_t0", "_transcript", "_batch",
-                 "_spans", "_enq")
+                 "_spans", "_enq", "_qdepth")
 
     def __init__(self, engine, resp, n, t0, transcript=None, batch=None,
                  spans=None):
@@ -144,11 +144,21 @@ class PendingRound:
         #: by the scheduler (set_enqueued_at) — the SLO's enqueue→settle
         #: anchor; None on the direct (schedulerless) path
         self._enq = None
+        #: scheduler queue depth at dispatch (ops left waiting after
+        #: this round's chunk was taken) — the workload telemetry's
+        #: backlog sample (obs/workload.py); None on the direct path
+        self._qdepth = None
 
     def set_enqueued_at(self, t_enq: float) -> None:
         """Stamp the oldest op's enqueue time (perf_counter seconds);
         must be called before ``resolve()``."""
         self._enq = t_enq
+
+    def set_queue_depth(self, depth: int) -> None:
+        """Stamp the post-dispatch scheduler backlog (an aggregate of
+        the queue, never of any op in it); must be called before
+        ``resolve()``."""
+        self._qdepth = int(depth)
 
     def note_span(self, name: str, start_s: float, dur_s: float) -> None:
         """Add a collector-side span (assembly/verify) to this round's
@@ -202,6 +212,12 @@ class PendingRound:
             # scheduler stamped the oldest op's enqueue; the direct path
             # anchors at dispatch start (no queue wait to account)
             slo.observe(t_done - (self._enq if self._enq is not None else r0))
+        wl = getattr(self._engine, "workload", None)
+        if wl is not None:
+            # batch fill + dispatch-time backlog + per-phase utilization
+            # from this round's span ledger (obs/workload.py) — a few
+            # histogram/gauge samples on the collector thread
+            wl.observe_round(self._n, bs, self._qdepth, spans)
         lm = self._engine.leakmon
         if lm is not None and self._transcript is not None:
             # one non-blocking queue put; detectors run on the monitor's
@@ -210,7 +226,7 @@ class PendingRound:
             # the canonical PHASES (+ round)
             phases = {k: d for k, (_, d) in spans.items() if k != "device"}
             lm.submit_round(self._batch, self._transcript, self._n, bs,
-                            phases)
+                            phases, queue_depth=self._qdepth)
         return out
 
 
@@ -245,6 +261,10 @@ class GrapevineEngine:
         #: rounds are not traced / measured against an SLO
         self.tracer = None
         self.slo = None
+        #: workload telemetry (obs/workload.py): batch fill / queue
+        #: depth / arrival-rate / utilization signals, attached by the
+        #: serving layer or the load harness; None = not sampled
+        self.workload = None
         #: crash safety (engine/checkpoint.py): with a DurabilityConfig,
         #: every admitted batch is journaled before dispatch and the
         #: whole state checkpointed every N records; construction runs
@@ -301,6 +321,11 @@ class GrapevineEngine:
         """Attach an SloTracker; subsequent rounds observe their
         enqueue→settle commit latency against it."""
         self.slo = slo
+
+    def attach_workload(self, workload) -> None:
+        """Attach a WorkloadTelemetry; subsequent rounds observe their
+        fill/backlog/utilization and the scheduler notes arrivals."""
+        self.workload = workload
 
     def calibrate_sort_phase(self, reps: int = 5) -> float:
         """Measure the round's bounded-key sort workload standalone and

@@ -1,0 +1,34 @@
+"""Scenario-diverse load harness (ROADMAP item 4's measurement half).
+
+Deterministic, seeded, **open-loop** arrival-schedule generators plus a
+replay harness that drives them through the production BatchScheduler:
+
+- ``generators``: pre-materialized (timestamp, op-template) schedules —
+  steady Poisson, bursty ON/OFF, diurnal sinusoid, pop-heavy mailbox
+  drain, an adversarial probe campaign aimed at the leakmon detectors,
+  and a ramp-to-saturation staircase;
+- ``harness``: ``ScenarioRunner`` (open-loop replay via
+  ``BatchScheduler.submit_nowait`` — overload latency is measured, not
+  self-throttled) and the probe-campaign leak injector for the
+  /leakaudit discrimination drill;
+- ``capacity``: per-step SLO accounting over a ramp schedule and the
+  saturation-knee model behind the repo's banked capacity number
+  (``bench.py load_scenarios``).
+"""
+
+from .generators import (  # noqa: F401
+    Schedule,
+    adversarial_probe,
+    bursty_onoff,
+    diurnal_sinusoid,
+    pop_heavy_drain,
+    ramp_to_saturation,
+    steady_poisson,
+)
+from .harness import (  # noqa: F401
+    ProbeCampaignInjector,
+    RunResult,
+    ScenarioRunner,
+    calibrate_unloaded_round,
+)
+from .capacity import analyze_ramp, find_knee  # noqa: F401

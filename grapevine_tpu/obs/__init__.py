@@ -32,7 +32,12 @@ leak policy structurally rather than by convention:
   per round) with multi-window burn-rate alerting folded into
   ``/healthz``;
 - ``profiler``: gated programmatic ``jax.profiler`` capture of a live
-  engine (``/profile?ms=N``, ``--profile-enable``).
+  engine (``/profile?ms=N``, ``--profile-enable``);
+- ``workload``: batch-level workload telemetry — fixed-bucket batch
+  fill-fraction and queue-depth histograms at round cadence, an
+  arrival-rate EWMA gauge, per-phase utilization from the tracer span
+  ledgers, and saturation/backpressure counters (the signals the
+  ``grapevine_tpu/load`` scenario harness measures against).
 """
 
 from .registry import (  # noqa: F401
@@ -56,14 +61,16 @@ from .leakmon import (  # noqa: F401
 from .tracer import RoundTracer  # noqa: F401
 from .slo import SloConfig, SloTracker  # noqa: F401
 from .profiler import ProfilerBusy, ProfilerGate  # noqa: F401
+from .workload import WorkloadTelemetry  # noqa: F401
 
 
 def attach_round_observability(engine, registry, *, trace_ring_size=512,
                                slo=None, profile_enable=False):
-    """Attach the round tracer + commit-latency SLO (always on for the
-    device owner — both cost a few dict ops per ROUND, not per op) and
-    the optional profiler gate to ``engine``; the ONE place the serving
-    layers (server/service.py, server/tier.py) share the policy.
+    """Attach the round tracer + commit-latency SLO + workload
+    telemetry (always on for the device owner — all three cost a few
+    dict/histogram ops per ROUND, not per op) and the optional
+    profiler gate to ``engine``; the ONE place the serving layers
+    (server/service.py, server/tier.py) share the policy.
 
     No explicit SLO config = observe-only (the CLI-default contract,
     server/cli.py ``_slo_config``): latencies and burn rates export,
@@ -81,4 +88,11 @@ def attach_round_observability(engine, registry, *, trace_ring_size=512,
         registry=registry,
     )
     engine.attach_slo(slo_tracker)
+    # the workload observatory's serving-side half (obs/workload.py):
+    # fill/depth at round cadence, arrival EWMA, phase utilization —
+    # the queue-depth signal ROADMAP item 4's adaptive batcher needs
+    # exists on every production engine, not only under the harness
+    engine.attach_workload(
+        WorkloadTelemetry(registry, batch_size=engine.ecfg.batch_size)
+    )
     return tracer, slo_tracker, ProfilerGate() if profile_enable else None

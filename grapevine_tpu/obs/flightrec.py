@@ -42,6 +42,8 @@ ALLOWED_FIELDS = frozenset({
     "batch_size",  # configured slots per round
     "n_real",      # real (non-padding) ops in the round — an aggregate
     "fill",        # n_real / batch_size
+    "queue_depth", # ops left waiting at dispatch (scheduler backlog —
+                   # an aggregate of the queue, never of any op in it)
     "phase_s",     # {phase: seconds} host phase timings for this round
     "stats",       # {tree: {stat: number}} windowed detector statistics
     "verdict",     # "PASS" / "SUSPECT" at the time the round was recorded

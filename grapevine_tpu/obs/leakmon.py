@@ -471,12 +471,13 @@ class EngineLeakMonitor:
 
     def submit_round(
         self, batch: dict, transcript, n_real: int, batch_size: int,
-        phases: dict | None = None,
+        phases: dict | None = None, queue_depth: int | None = None,
     ) -> bool:
         """Enqueue one round's transcript; False = dropped (queue full)."""
         try:
             self._q.put_nowait((batch, transcript, n_real, batch_size,
-                                dict(phases) if phases else {}))
+                                dict(phases) if phases else {},
+                                queue_depth))
         except queue.Full:
             if self._c_dropped is not None:
                 self._c_dropped.inc()
@@ -516,7 +517,8 @@ class EngineLeakMonitor:
                 self._processed += 1
                 self._q.task_done()
 
-    def _process(self, batch, transcript, n_real, batch_size, phases):
+    def _process(self, batch, transcript, n_real, batch_size, phases,
+                 queue_depth=None):
         # lazy import: obs must stay importable without the engine
         # package (and this breaks the obs ↔ engine import cycle)
         from ..engine.round_step import transcript_key_groups
@@ -598,6 +600,7 @@ class EngineLeakMonitor:
             "batch_size": int(batch_size),
             "n_real": int(n_real),
             "fill": round(n_real / batch_size, 4) if batch_size else 0.0,
+            "queue_depth": int(queue_depth) if queue_depth is not None else 0,
             "phase_s": {k: round(float(x), 6) for k, x in phases.items()},
             "stats": {t: self.monitor.stats(t)
                       for t in self.monitor.streams},

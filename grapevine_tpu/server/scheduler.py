@@ -100,6 +100,20 @@ class BatchScheduler:
         With ``auth`` set, the signature is verified as part of the
         round's batch; raises AuthFailure (and the op never reaches the
         engine) if it does not verify."""
+        return self.submit_nowait(req, auth).result()
+
+    def submit_nowait(
+        self, req: QueryRequest, auth: AuthItem | None = None
+    ) -> Future:
+        """Enqueue one op and return its Future without waiting.
+
+        The open-loop entry point (grapevine_tpu/load): an arrival
+        joins the queue at its scheduled time regardless of how earlier
+        ops are faring, so overload latency is *measured* (the queue
+        grows and enqueue→settle waits stretch) instead of silently
+        self-throttled by a blocked caller. The Future resolves to the
+        op's QueryResponse, or raises AuthFailure / SchedulerShutdown /
+        the round's error exactly as ``submit`` would."""
         fut: Future = Future()
         # perf_counter enqueue stamp: the SLO's enqueue→settle anchor
         # (one clock domain with the batcher's round spans); the
@@ -109,13 +123,19 @@ class BatchScheduler:
             if self._closed:
                 raise SchedulerShutdown("scheduler closed")
             self._queue.append((req, auth, fut, t_enq))
+            depth = len(self._queue)
             self._last_enqueue = time.monotonic()
-            if len(self._queue) == 1:
+            if depth == 1:
                 self._head_enqueue = self._last_enqueue
             if self.metrics is not None:
-                self.metrics.observe_queue_depth(len(self._queue))
+                self.metrics.observe_queue_depth(depth)
             self._cv.notify()
-        return fut.result()
+        wl = getattr(self.engine, "workload", None)
+        if wl is not None:
+            # outside the cv: a couple of registry samples must never
+            # extend the collector's critical section
+            wl.note_arrival(depth)
+        return fut
 
     # -- health probes (obs/httpd.py's /healthz) ------------------------
 
@@ -227,6 +247,7 @@ class BatchScheduler:
                             break
                         self._cv.wait(timeout=wait_until - now)
                     chunk, self._queue = self._queue[:bs], self._queue[bs:]
+                    backlog = len(self._queue)
                     asm_s = time.monotonic() - t_asm0
                     if self._queue:
                         # remaining head has been waiting since roughly
@@ -275,6 +296,10 @@ class BatchScheduler:
                         if getattr(pending, "note_span", None) is not None:
                             pending.note_span("assembly", t_asm0_pc, asm_s)
                             pending.note_span("verify", t_v0_pc, ver_s)
+                            # post-dispatch backlog: the queue-depth
+                            # sample obs/workload.py histograms at
+                            # round cadence (and flightrec records)
+                            pending.set_queue_depth(backlog)
                             # anchor on the ops that actually entered
                             # the round: an auth-rejected op's queue
                             # wait is not a commit latency, and letting

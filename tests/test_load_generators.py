@@ -1,0 +1,162 @@
+"""Generator determinism and schedule shape (ISSUE 9 satellite).
+
+Three properties, per generator:
+
+- **determinism**: the same seed materializes the bit-identical
+  schedule (fingerprint equality), a different seed a different one —
+  a load scenario is a reproducible experiment, not a vibe;
+- **shape**: the empirical arrival envelope matches the generator's
+  *declared* one (bursty silence in OFF windows, diurnal peak/trough
+  contrast, pop-heavy recipient concentration, ramp staircase
+  monotonicity) — a generator whose output does not look like its name
+  would silently invalidate every capacity number taken through it;
+- **open-loop**: schedules are pure functions of (params, seed) with
+  no completion-time input anywhere in the signature, and the replay
+  harness (tested in test_load_harness.py) never mutates them.
+
+Pure numpy — no engine, no jax, milliseconds in tier-1.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.load import generators as G
+from grapevine_tpu.wire import constants as C
+
+ALL_GENERATORS = {
+    "steady": lambda seed: G.steady_poisson(200.0, 4.0, seed),
+    "bursty": lambda seed: G.bursty_onoff(400.0, 0.25, 1.0, 4.0, seed),
+    "diurnal": lambda seed: G.diurnal_sinusoid(200.0, 0.8, 2.0, 4.0, seed),
+    "pop_heavy": lambda seed: G.pop_heavy_drain(200.0, 4.0, seed),
+    "adversarial": lambda seed: G.adversarial_probe(0.05, 4.0, seed),
+    "ramp": lambda seed: G.ramp_to_saturation(50.0, 2.0, 4, 1.0, seed),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ALL_GENERATORS))
+def test_same_seed_same_schedule(name):
+    gen = ALL_GENERATORS[name]
+    a, b = gen(7), gen(7)
+    assert a.fingerprint() == b.fingerprint()
+    assert np.array_equal(a.t_s, b.t_s)
+    assert np.array_equal(a.kind, b.kind)
+    assert np.array_equal(a.auth, b.auth)
+    assert np.array_equal(a.recipient, b.recipient)
+    assert gen(8).fingerprint() != a.fingerprint()
+
+
+@pytest.mark.parametrize("name", sorted(ALL_GENERATORS))
+def test_schedule_is_well_formed(name):
+    s = ALL_GENERATORS[name](3)
+    assert s.n_ops > 0
+    assert np.all(np.diff(s.t_s) >= 0), "arrivals must be sorted"
+    assert s.t_s[0] >= 0 and s.t_s[-1] <= s.duration_s
+    assert set(np.unique(s.kind)) <= {
+        C.REQUEST_TYPE_CREATE, C.REQUEST_TYPE_READ, C.REQUEST_TYPE_DELETE
+    }
+    n_id = s.meta["n_idents"]
+    assert int(s.auth.max()) < n_id and int(s.recipient.max()) < n_id
+
+
+@pytest.mark.parametrize("name", sorted(ALL_GENERATORS))
+def test_open_loop_signature(name):
+    """No generator takes any completion/latency/feedback input: the
+    schedule cannot depend on how the server fares — the structural
+    half of the open-loop property (the behavioral half is the
+    harness replay test)."""
+    fn = {
+        "steady": G.steady_poisson, "bursty": G.bursty_onoff,
+        "diurnal": G.diurnal_sinusoid, "pop_heavy": G.pop_heavy_drain,
+        "adversarial": G.adversarial_probe, "ramp": G.ramp_to_saturation,
+    }[name]
+    params = set(inspect.signature(fn).parameters)
+    forbidden = {"latency", "latencies", "completions", "responses",
+                 "feedback", "engine", "scheduler", "clock"}
+    assert not (params & forbidden), (
+        f"{name} takes completion-side input {params & forbidden} — "
+        "that is a closed loop"
+    )
+
+
+def test_steady_rate_matches_declared():
+    s = G.steady_poisson(500.0, 8.0, 5)
+    # Poisson(4000) total count: 5 sigma ≈ 316
+    assert abs(s.n_ops - 4000) < 320
+    rates = s.empirical_rate(8)
+    assert np.all(rates > 250) and np.all(rates < 750)
+
+
+def test_bursty_off_windows_are_silent():
+    s = G.bursty_onoff(800.0, 0.25, 1.0, 4.0, 5)
+    phase = np.mod(s.t_s, 1.0)
+    assert np.all(phase <= 0.25 + 1e-9), "arrivals outside ON windows"
+    # mean rate ≈ rate_on * duty
+    assert abs(s.offered_rate - 200.0) < 60.0
+    # peak-to-mean contrast is the declared 1/duty
+    rates = s.empirical_rate(16)  # 4 bins per period, 1 ON per period
+    assert rates.max() > 3.0 * max(1e-9, np.median(rates + 1e-9))
+
+
+def test_diurnal_peak_trough_contrast():
+    s = G.diurnal_sinusoid(400.0, 0.9, 4.0, 8.0, 5)
+    # bin phases against the declared sinusoid: peak quarter vs trough
+    phase = np.mod(s.t_s, 4.0) / 4.0
+    peak = np.sum((phase >= 0.125) & (phase < 0.375))   # around sin max
+    trough = np.sum((phase >= 0.625) & (phase < 0.875))  # around sin min
+    assert peak > 4 * max(1, trough), (peak, trough)
+    # total mass still ≈ mean_rate * duration
+    assert abs(s.n_ops - 3200) < 450
+
+
+def test_pop_heavy_concentration_and_drains():
+    s = G.pop_heavy_drain(400.0, 8.0, 5, n_idents=64, n_hot=4,
+                          hot_frac=0.75, drain_frac=0.4)
+    creates = s.kind == C.REQUEST_TYPE_CREATE
+    drains = ~creates
+    # ~75% of CREATEs land on the 4 hot recipients (vs 6% uniform)
+    hot_share = np.mean(s.recipient[creates] < 4)
+    assert hot_share > 0.6, hot_share
+    # drains are issued BY hot identities popping their own inboxes
+    assert np.all(s.auth[drains] < 4)
+    assert 0.25 < np.mean(drains) < 0.55
+    drain_kinds = set(np.unique(s.kind[drains]))
+    assert drain_kinds <= {C.REQUEST_TYPE_READ, C.REQUEST_TYPE_DELETE}
+
+
+def test_adversarial_probe_shape():
+    s = G.adversarial_probe(0.1, 2.0, 5, n_probe_keys=4,
+                            probes_per_pulse=3)
+    # tiny key set, READ-only, every key probed in every pulse
+    assert set(np.unique(s.auth)) == {0, 1, 2, 3}
+    assert np.all(s.kind == C.REQUEST_TYPE_READ)
+    assert s.n_ops == 20 * 4 * 3
+    # pulses are tight: every op lands within ~1ms of its pulse start
+    assert np.all(np.mod(s.t_s, 0.1) < 2e-3)
+
+
+def test_ramp_staircase_is_monotone_and_declared():
+    s = G.ramp_to_saturation(100.0, 2.0, 4, 2.0, 5)
+    steps = s.meta["steps"]
+    declared = [st["offered_rate"] for st in steps]
+    assert declared == [100.0, 200.0, 400.0, 800.0]
+    empirical = []
+    for st in steps:
+        n = np.sum((s.t_s >= st["t0"]) & (s.t_s < st["t1"]))
+        empirical.append(n / (st["t1"] - st["t0"]))
+    # each step's realized rate is within 5 sigma of its declared one
+    for emp, dec in zip(empirical, declared):
+        assert abs(emp - dec) < 5.0 * np.sqrt(dec / 2.0) + 1e-9
+    assert np.all(np.diff(empirical) > 0), "staircase must ascend"
+
+
+def test_malformed_parameters_raise():
+    with pytest.raises(ValueError):
+        G.bursty_onoff(100.0, 1.5, 1.0, 4.0, 0)  # duty > 1
+    with pytest.raises(ValueError):
+        G.diurnal_sinusoid(100.0, 1.5, 1.0, 4.0, 0)  # amplitude >= 1
+    with pytest.raises(ValueError):
+        G.ramp_to_saturation(100.0, 0.5, 4, 1.0, 0)  # shrinking ramp
+    with pytest.raises(ValueError):
+        G.pop_heavy_drain(100.0, 4.0, 0, n_idents=4, n_hot=4)  # all hot

@@ -171,6 +171,10 @@ def test_tree_cache_campaign_with_sweep_soak_and_leakmon():
     mon.close()
 
 
+@pytest.mark.slow  # ~35 s of oram-level cached/uncached equality
+# breadth. Moved in the PR-9 tier-1 re-budget: the engine-level
+# campaign above (sweep+soak+leakmon, logical-state equality) and the
+# access-schedule CI audit keep the cache contract always-on.
 def test_tree_cache_oram_level_directed():
     """Directed small-ORAM checks with NO engine compile: single
     ``oram_access`` CRUD against cached and uncached trees stays

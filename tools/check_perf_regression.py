@@ -65,9 +65,17 @@ GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
                  "rounds", "slo_target_ms")
 
-#: result fields that are neither geometry nor a directional metric
+#: result fields that are neither geometry nor a directional metric.
+#: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
+#: the replay dispatcher ran) — a property of the measuring host, not
+#: of the engine; knee_target_ms is the host-CALIBRATED knee SLO
+#: target (max(250, 8x unloaded round)) — config derived from a
+#: measurement, neither geometry (it would fragment every capacity
+#: series) nor a directional metric. Neither gates.
 SKIP_KEYS = ("note", "skipped", "error", "leakaudit", "verdict",
-             "interpret_trace_s", "compile_s", "wall_s")
+             "interpret_trace_s", "compile_s", "wall_s",
+             "dispatch_skew_p99_ms", "calibrated_round_ms",
+             "knee_target_ms")
 
 
 def _direction(name: str) -> int:
@@ -223,6 +231,47 @@ def selftest(factor: float) -> None:
     regs, n = compare_latest(extract_series([a, b]), factor)
     assert n == 0 and not regs, (
         "sentinel self-test: mismatched geometry was compared"
+    )
+    # the capacity metric path (PR 9, bench load_scenarios): the knee
+    # and per-scenario throughput/latency nest two dicts deep — the
+    # flattener must produce comparable series for them, fire past the
+    # factor, and skip the honesty/calibration fields. knee_target_ms
+    # VARIES between the two synthetic lines on purpose: it is
+    # perf_counter-calibrated in real runs, and were it geometry (or a
+    # gated metric) every run would mint a fresh series and the
+    # capacity numbers would never be compared at all.
+    mk_cap = lambda knee, p99, tgt: {  # noqa: E731
+        "sizes": "full", "backend": "cpu", "pr": "synthetic",
+        "configs": {"load_scenarios": {
+            "batch": 16, "capacity_log2": 14, "knee_target_ms": tgt,
+            "knee_ops_per_sec": knee,
+            "scenarios": {"steady": {
+                "achieved_ops_per_sec": knee * 0.5,
+                "p99_commit_ms": p99,
+                "dispatch_skew_p99_ms": p99 * 100.0,  # must NOT gate
+                "leakaudit": "PASS",
+            }},
+        }},
+    }
+    regs, n = compare_latest(
+        extract_series([mk_cap(200.0, 40.0, 3250.7),
+                        mk_cap(200.0 / (factor * 2.0),
+                               40.0 * factor * 2.0, 2871.3)]),
+        factor,
+    )
+    assert n == 3 and len(regs) == 3, (
+        f"sentinel self-test: capacity series not gated ({n=}, {regs}) "
+        "— a calibration-varying field fragmented the series keys?"
+    )
+    assert not any("dispatch_skew" in r or "knee_target" in r
+                   for r in regs), (
+        "sentinel self-test: an honesty/calibration field was gated"
+    )
+    regs, n = compare_latest(
+        extract_series([mk_cap(200.0, 40.0, 3250.7),
+                        mk_cap(200.0, 40.0, 2871.3)]), factor)
+    assert n == 3 and not regs, (
+        f"sentinel self-test: steady capacity series flagged ({regs})"
     )
 
 

@@ -232,6 +232,78 @@ def audit_trace_slo_registry() -> dict:
     return report
 
 
+def audit_workload_registry() -> dict:
+    """Runtime pass over the workload observatory's metric namespace
+    (ISSUE-9 satellite — the ``grapevine_load_*`` families plus the
+    flight recorder's queue-depth summary field):
+
+    - the fill/depth histograms, arrival counter/gauge, utilization
+      gauge, and saturation/backpressure counters exist; the ONLY
+      label key anywhere in the namespace is ``phase`` (on the
+      utilization gauge, with registration-declared values) — no
+      dimension in which a client, key, or op type could travel;
+    - histogram buckets are the registration-time FILL/DEPTH constants
+      (fixed-bucket contract; a data-dependent layout is a signal);
+    - schema teeth: the flight recorder accepts a scalar
+      ``queue_depth`` and rejects an array-valued one with
+      TelemetryLeakError (an array is how per-op data would ride a
+      batch-level field).
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.engine.metrics import EngineMetrics
+    from grapevine_tpu.obs.flightrec import FlightRecorder
+    from grapevine_tpu.obs.registry import TelemetryLeakError
+    from grapevine_tpu.obs.workload import (
+        DEPTH_BUCKETS,
+        FILL_BUCKETS,
+        WorkloadTelemetry,
+    )
+
+    em = EngineMetrics()
+    WorkloadTelemetry(em.registry, batch_size=256)
+    report = em.registry.audit()  # raises on any violation
+
+    families = [
+        m for m in em.registry.collect()
+        if m.name.startswith("grapevine_load_")
+    ]
+    if len(families) < 6:
+        raise SystemExit(
+            "workload namespace missing: WorkloadTelemetry registered "
+            f"only {[m.name for m in families]}"
+        )
+    for m in families:
+        bad = set(m.label_keys) - {"phase"}
+        if bad:
+            raise SystemExit(
+                f"workload metric {m.name!r} carries label keys "
+                f"{sorted(bad)} — workload telemetry may only "
+                "aggregate by phase"
+            )
+    fill = em.registry.get("grapevine_load_batch_fill")
+    depth = em.registry.get("grapevine_load_queue_depth")
+    if fill is None or fill.buckets != tuple(FILL_BUCKETS):
+        raise SystemExit("fill histogram buckets drifted from the "
+                         "registration-time constants")
+    if depth is None or depth.buckets != tuple(DEPTH_BUCKETS):
+        raise SystemExit("depth histogram buckets drifted from the "
+                         "registration-time constants")
+
+    fr = FlightRecorder(capacity=2)
+    fr.record({"seq": 1, "fill": 0.5, "queue_depth": 17})  # scalar: fine
+    try:
+        fr.record({"seq": 2, "queue_depth": [1, 2, 3]})
+    except TelemetryLeakError:
+        pass
+    else:
+        raise SystemExit(
+            "flight recorder accepted an array-valued queue_depth — "
+            "the batch-level schema has no teeth"
+        )
+    report["workload_families"] = len(families)
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
@@ -239,6 +311,7 @@ def main() -> int:
     report = audit_shipped_registry()
     lm_report = audit_leakmon_registry()
     ts_report = audit_trace_slo_registry()
+    wl_report = audit_workload_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
@@ -246,7 +319,8 @@ def main() -> int:
         f"leakmon audit ok ({lm_report['leakmon_families']} families, "
         f"{lm_report['series']} series incl. engine); trace/slo audit "
         f"ok ({ts_report['trace_slo_families']} families, ring schema "
-        "enforced)"
+        f"enforced); workload audit ok ({wl_report['workload_families']} "
+        "families, fixed buckets, depth-field teeth)"
     )
     return 1 if violations else 0
 

@@ -37,6 +37,15 @@ closes. Stages, most valuable first (VERDICT r4 next-round #1/#2/#5):
                  deployment can be profiled without restart, and banks
                  the first device bubble ratio (the number that sizes
                  the pipelined-round refactor, ROADMAP item 2)
+6c. load_perf  — ramp-to-knee under the real device round (the PR-9
+                 workload observatory on a chip): open-loop ramp
+                 through the scheduler with workload telemetry +
+                 tracer on, banks the device capacity knee AND the
+                 bubble ratio *under load* — the pair of numbers that
+                 decides how much throughput the ROADMAP-item-2
+                 pipelined-round refactor can actually buy (a knee set
+                 by host phases pipelines away; one set by device
+                 rounds does not)
 7. fullbench   — bench.py end to end on the live backend (full pass
                  only): the driver-format artifact as a dress
                  rehearsal, and it warms the shared compilation cache
@@ -592,6 +601,80 @@ def stage_live_profile(cap, args):
              slo_ok=v["ok"], slo_fast_burn=v["fast_burn_rate"])
 
 
+def stage_load_perf(cap, args):
+    """Ramp-to-knee under the real device round (PR 9; the TPU
+    decision input for ROADMAP item 2). Same harness as ``bench.py
+    load_scenarios``: calibrate the unloaded round, staircase offered
+    load past the estimate open-loop (``submit_nowait`` — overload is
+    measured, never self-throttled), grade each step against the
+    commit SLO, and bank the knee together with the bubble ratio the
+    tracer measured UNDER that load — the host/device balance at
+    capacity is what prices double-buffered rounds."""
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ScenarioRunner,
+        analyze_ramp,
+        calibrate_unloaded_round,
+        ramp_to_saturation,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.obs.workload import WorkloadTelemetry
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    cl, b = (14, 16) if args.quick else (18, 256)
+    cfg = GrapevineConfig(max_messages=1 << cl, max_recipients=1 << 10,
+                          batch_size=b)
+    engine = GrapevineEngine(cfg)
+    tracer = RoundTracer(capacity=512, registry=engine.metrics.registry)
+    engine.attach_tracer(tracer)
+    wl = WorkloadTelemetry(engine.metrics.registry, batch_size=b)
+    engine.attach_workload(wl)
+
+    # compile + the unloaded round — the shared knee methodology
+    # (load/harness.py), so this stage and bench load_scenarios can
+    # never diverge on the target formula
+    t_round, est, target_ms = calibrate_unloaded_round(engine,
+                                                       1_700_000_000)
+
+    sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+    try:
+        runner = ScenarioRunner(sched, n_idents=64, settle_timeout_s=180.0)
+        # settle the scheduler pipeline before the graded ramp
+        runner.run(steady_poisson(0.25 * est, 1.0, 7))
+        # snapshot the fill histogram here: the banked mean_fill must
+        # cover the GRADED ramp only, not the full-batch calibration
+        # rounds or the quarter-rate settle run above
+        fill_child = engine.metrics.registry.get(
+            "grapevine_load_batch_fill").child()
+        _, fill_sum0, fill_n0 = fill_child.state()
+        # steps must dwarf the commit latency (≈ a couple of rounds)
+        # or overload never expresses inside a step (bench.py rule)
+        schedule = ramp_to_saturation(
+            0.25 * est, 2.0, 5, max(2.0, 12.0 * t_round), 9)
+        res = runner.run(schedule)
+    finally:
+        sched.close()
+    ramp = analyze_ramp(schedule, res, target_ms)
+    trace = tracer.chrome_trace()
+    _, fill_sum, fill_n = fill_child.state()
+    d_sum, d_n = fill_sum - fill_sum0, fill_n - fill_n0
+    cap.emit(
+        "load_perf", capacity_log2=cl, batch=b,
+        calibrated_round_ms=round(t_round * 1e3, 2),
+        knee_target_ms=round(target_ms, 1),
+        knee_ops_per_sec=ramp["knee_ops_per_sec"],
+        saturated=ramp["saturated"],
+        first_failing_rate=ramp["first_failing_rate"],
+        steps=ramp["steps"],
+        bubble_ratio_under_load=trace["otherData"]["bubble_ratio"],
+        utilization={k: round(v, 4) for k, v in wl.utilization().items()},
+        p99_commit_ms=res.summary().get("p99_commit_ms"),
+        mean_fill=round(d_sum / d_n, 3) if d_n else None,
+    )
+
+
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
@@ -604,6 +687,11 @@ STAGES = [
     # live_profile right after trace: same geometry family, proves the
     # runtime /profile path and banks the device bubble ratio cheaply
     ("live_profile", stage_live_profile, 900),
+    # load_perf next: reuses the live_profile geometry family's cached
+    # compiles, and the knee + under-load bubble pair is the ROADMAP
+    # item-2 decision input (more valuable than the remaining A/Bs if
+    # the window closes here)
+    ("load_perf", stage_load_perf, 1200),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
