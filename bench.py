@@ -34,6 +34,12 @@ Configs (BASELINE.md / BASELINE.json, plus two extensions):
                          PR6): enqueue→settle latency, burn rates, and
                          the host/device bubble ratio — runs everywhere
                          (no session crypto in the loop)
+  7b. pipeline_ab        round-pipeline depth A/B (PR10): depth 1
+                         (serial) vs depth 2 (collection window +
+                         journal fsync overlap the in-flight device
+                         rounds) through the scheduler with fsync ON —
+                         sustained throughput + commit p99 per depth,
+                         min-of-N interleaved; runs everywhere
   8. load_scenarios      the workload observatory (PR9): open-loop
                          scenario suite (steady/bursty/diurnal/
                          pop-heavy/adversarial/ramp) through the
@@ -1378,6 +1384,149 @@ def bench_slo_loopback(smoke):
         sched.close()
 
 
+def bench_pipeline_ab(smoke):
+    """Config 7b: round-pipeline depth A/B (PR 10; ROADMAP item 2).
+
+    Whole-round sustained throughput + enqueue→settle commit latency
+    through the production BatchScheduler at ``pipeline_depth`` 1 (the
+    serial pre-PR-10 program) vs 2 (round k+1's collection window,
+    verification, and journal fsync overlap rounds k/k+1 on the
+    device), **fsync on**: each arm journals every round to its own
+    state dir with ``journal_fsync_every=1`` and checkpoints pushed out
+    of the window, so the A/B prices exactly the claim — at depth 2 the
+    fsync barrier overlaps device execution instead of serializing
+    with it. Min-of-N interleaved at the whole-rep level (the
+    vphases/sort/posmap playbook): arms alternate rep by rep so drift
+    in the shared host hits both equally; per arm the best rep's
+    throughput and the minimum p99 are reported. The tracer rides both
+    arms and contributes the measured journal-span p99 and the bubble
+    ratio. No session crypto in the loop — runs in every container."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from grapevine_tpu.config import DurabilityConfig, GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    cap, n_clients, per_client, batch, reps = (
+        (1 << 10, 2, 6, 4, 2) if smoke else (1 << 16, 8, 48, 16, 3)
+    )
+    rng = np.random.default_rng(23)
+    idents = rng.integers(1, 256, (n_clients, 32)).astype(np.uint8)
+    recips = rng.integers(1, 256, (64, 32)).astype(np.uint8)
+
+    def mk_req(j, i):
+        return QueryRequest(
+            request_type=C.REQUEST_TYPE_CREATE,
+            auth_identity=idents[j].tobytes(),
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID,
+                recipient=recips[(j * per_client + i) % len(recips)].tobytes(),
+                payload=bytes([i & 0xFF]) * C.PAYLOAD_SIZE,
+            ),
+        )
+
+    tmp = tempfile.mkdtemp(prefix="gv-pipeline-ab-")
+    arms: dict = {}
+    try:
+        for depth in (1, 2):
+            cfg = GrapevineConfig(
+                max_messages=cap, max_recipients=1 << 10, batch_size=batch,
+                bucket_cipher_rounds=0 if smoke else 8,
+                pipeline_depth=depth,
+            )
+            dcfg = DurabilityConfig(
+                state_dir=os.path.join(tmp, f"d{depth}"),
+                # no checkpoint inside the timed window: the A/B prices
+                # the per-round fsync, not the periodic state seal
+                checkpoint_every_rounds=1 << 20,
+                journal_fsync_every=1,
+            )
+            engine = GrapevineEngine(cfg, durability=dcfg)
+            tracer = RoundTracer(capacity=2048,
+                                 registry=engine.metrics.registry)
+            engine.attach_tracer(tracer)
+            sched = BatchScheduler(engine, clock=lambda: NOW)
+            warm = sched.submit(mk_req(0, 0))  # compile outside the window
+            assert warm.status_code == C.STATUS_CODE_SUCCESS
+            arms[depth] = {"engine": engine, "tracer": tracer,
+                           "sched": sched, "ops": 0.0, "p99": None,
+                           "p50": None}
+
+        def one_rep(arm):
+            lat: list[float] = []
+            errs: list = []
+            lock = threading.Lock()
+
+            def run(j):
+                try:
+                    for i in range(per_client):
+                        req = mk_req(j, i)
+                        t0 = time.perf_counter()
+                        r = arm["sched"].submit(req)
+                        assert r.status_code == C.STATUS_CODE_SUCCESS, (
+                            r.status_code)
+                        with lock:
+                            lat.append(time.perf_counter() - t0)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=run, args=(j,))
+                       for j in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            total = time.perf_counter() - t0
+            assert not errs, errs[0]
+            ops = n_clients * per_client / total
+            arm["ops"] = max(arm["ops"], ops)
+            p99, p50 = _p99(lat), float(np.median(lat)) * 1e3
+            arm["p99"] = p99 if arm["p99"] is None else min(arm["p99"], p99)
+            arm["p50"] = p50 if arm["p50"] is None else min(arm["p50"], p50)
+
+        for _ in range(reps):  # interleaved: drift hits both arms
+            for depth in (1, 2):
+                one_rep(arms[depth])
+
+        out: dict = {"batch": batch, "capacity_log2": cap.bit_length() - 1,
+                     "clients": n_clients, "reps": reps, "fsync": True}
+        for depth in (1, 2):
+            arm = arms[depth]
+            trace = arm["tracer"].chrome_trace()
+            j_ms = arm["tracer"].span_durations_ms("journal")
+            out[f"depth{depth}"] = {
+                "ops_per_sec": round(arm["ops"], 1),
+                "p99_commit_ms": round(arm["p99"], 2),
+                "median_commit_ms": round(arm["p50"], 2),
+                "journal_p99_ms": round(
+                    float(np.percentile(j_ms, 99, method="higher")), 3)
+                if j_ms else None,
+                "journal_mean_ms": round(float(np.mean(j_ms)), 3)
+                if j_ms else None,
+                "bubble_ratio": trace["otherData"]["bubble_ratio"],
+                "rounds": trace["otherData"]["rounds_recorded_total"],
+            }
+        d1, d2 = out["depth1"], out["depth2"]
+        out["speedup_ops_d2_over_d1"] = round(
+            d2["ops_per_sec"] / d1["ops_per_sec"], 3)
+        out["p99_delta_ms_d1_minus_d2"] = round(
+            d1["p99_commit_ms"] - d2["p99_commit_ms"], 2)
+        return out
+    finally:
+        for arm in arms.values():
+            arm["sched"].close()
+            arm["engine"].close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_load_scenarios(smoke):
     """Config 8: the workload observatory (PR9; ROADMAP item 4's
     measurement half). Open-loop scenario suite through the production
@@ -1418,9 +1567,11 @@ def bench_load_scenarios(smoke):
     from grapevine_tpu.server.scheduler import BatchScheduler
 
     cap, batch, dur = (1 << 10, 4, 1.5) if smoke else (1 << 14, 16, 3.0)
+    pd = _pipeline_depth_arg()
     cfg = GrapevineConfig(
         max_messages=cap, max_recipients=1 << 10, batch_size=batch,
         bucket_cipher_rounds=0 if smoke else 8,
+        pipeline_depth=pd,
     )
     engine = GrapevineEngine(cfg)
     wl = WorkloadTelemetry(engine.metrics.registry, batch_size=batch)
@@ -1465,6 +1616,11 @@ def bench_load_scenarios(smoke):
         "knee_target_ms": round(target_ms, 1),
         "batch": batch, "capacity_log2": cap.bit_length() - 1,
     }
+    if pd is not None:
+        # explicit depth reruns (the PR-10 knee-delta question) key
+        # their own sentinel series; auto runs keep the PR-9 series
+        # continuous by omitting the field entirely
+        out["pipeline_depth"] = pd
     for name, schedule in schedules.items():
         # fresh monitor per scenario (registry=None: the engine registry
         # already carries the serving leakmon families; per-scenario
@@ -1545,6 +1701,7 @@ CONFIGS = [
     ("sharded", bench_sharded),
     ("server_loopback", bench_server_loopback),
     ("slo_loopback", bench_slo_loopback),
+    ("pipeline_ab", bench_pipeline_ab),
     ("load_scenarios", bench_load_scenarios),
 ]
 
@@ -1627,13 +1784,8 @@ def _pr_tag() -> str:
     on the command line, else $GRAPEVINE_PR, else empty."""
     import os
 
-    argv = sys.argv[1:]
-    for i, tok in enumerate(argv):
-        if tok == "--pr" and i + 1 < len(argv):
-            return argv[i + 1]
-        if tok.startswith("--pr="):
-            return tok[len("--pr="):]
-    return os.environ.get("GRAPEVINE_PR", "")
+    val = _argv_flag_value("--pr")
+    return val if val is not None else os.environ.get("GRAPEVINE_PR", "")
 
 
 def _append_trajectory(line: dict, tag: str) -> None:
@@ -1654,17 +1806,40 @@ def _append_trajectory(line: dict, tag: str) -> None:
         print(f"[bench] trajectory append failed: {e}", file=sys.stderr)
 
 
+def _argv_flag_value(name: str) -> str | None:
+    """Last value of ``--name V`` / ``--name=V`` on the command line,
+    or None — the one token scan shared by the bench's ad-hoc flags
+    (``--pr``, ``--only``, ``--pipeline-depth``)."""
+    argv = sys.argv[1:]
+    val = None
+    for i, tok in enumerate(argv):
+        if tok == name and i + 1 < len(argv):
+            val = argv[i + 1]
+        elif tok.startswith(name + "="):
+            val = tok[len(name) + 1:]
+    return val
+
+
+def _pipeline_depth_arg() -> int | None:
+    """``--pipeline-depth N`` (or ``=N``): run the scheduler-driven
+    configs (load_scenarios) at an explicit round-pipeline depth — the
+    ISSUE-10 knee-delta rerun — instead of the engine auto."""
+    val = _argv_flag_value("--pipeline-depth")
+    if val is None:
+        return None
+    try:
+        return int(val)
+    except ValueError:
+        raise SystemExit(
+            f"--pipeline-depth: want an integer depth, got {val!r}"
+        ) from None
+
+
 def _only_filter() -> list | None:
     """``--only a,b`` (or ``--only=a,b``): run just those configs — for
     banking one config's line (e.g. a PR's A/B) without paying the full
     suite on a weak builder core. Unknown names fail fast."""
-    argv = sys.argv[1:]
-    val = None
-    for i, tok in enumerate(argv):
-        if tok == "--only" and i + 1 < len(argv):
-            val = argv[i + 1]
-        elif tok.startswith("--only="):
-            val = tok[len("--only="):]
+    val = _argv_flag_value("--only")
     if val is None:
         return None
     names = [n.strip() for n in val.split(",") if n.strip()]

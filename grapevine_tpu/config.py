@@ -157,6 +157,11 @@ class GrapevineConfig:
                 f"tree_top_cache_levels must be None (auto) or an int "
                 f">= 0, got {tc!r}"
             )
+        if self.pipeline_depth not in (None, 1, 2):
+            raise ValueError(
+                f"pipeline_depth must be None (auto), 1 or 2, got "
+                f"{self.pipeline_depth!r}"
+            )
         if self.commit == "op" and tc not in (None, 0):
             raise ValueError(
                 "commit='op' (the differential-oracle engine) supports "
@@ -251,6 +256,39 @@ class GrapevineConfig:
     #: the on-chip number lands via tools/tpu_capture.py
     #: ``tree_cache_perf``. Requires commit="phase".
     tree_top_cache_levels: int | None = None
+
+    #: round-pipeline depth: the number of dispatched-but-unresolved
+    #: engine rounds a driver holds at rest (engine/batcher.py,
+    #: server/scheduler.py; the scheduler's dispatch-then-settle order
+    #: — the depth-1 legacy sequence — means depth+1 rounds are
+    #: transiently in flight during each settle wait, so size device
+    #: resp/transcript residency as depth+1 rounds). 1 = the serial pre-PR-10 program, bit for
+    #: bit: a round fully settles (device wait + demux + delivery)
+    #: before the next one's window would close behind it. 2 = the
+    #: staged pipeline (ROADMAP item 2; Palermo's protocol/hardware
+    #: pipelining, arXiv:2411.05400): while round k executes on the
+    #: device, round k+1 is assembled and verified on the host and its
+    #: journal frame is appended AND fsynced — the fsync overlaps
+    #: device execution instead of serializing with it, so steady-state
+    #: cadence approaches max(host, fsync, device) and p99 commit
+    #: latency stops paying the fsync whenever a device round is in
+    #: flight behind it. Durability ordering is unchanged: a round is
+    #: journaled (and fsynced, per journal_fsync_every) strictly BEFORE
+    #: it dispatches, and rounds dispatch in journal order, so replay
+    #: order is journal order at every depth — never completion order
+    #: (the chaos invariant; tools/chaos_run.py --pipeline-depth 2).
+    #: Responses and final state are bit-identical at both depths
+    #: (tests/test_pipeline.py). None = auto: 2 on TPU backends — the
+    #: device round is the long pole there, overlap is the whole win,
+    #: and the on-chip A/B lands via tools/tpu_capture.py
+    #: ``pipeline_perf`` — and 1 elsewhere: on a host-bound CPU
+    #: (bubble ratio ≈ 0.0002) the second in-flight round has no device
+    #: window to hide work behind, and under open-loop sustained load
+    #: every op's round dispatches behind one extra unfinished device
+    #: round (+1 round of p99, measured; closed-loop bursty traffic
+    #: instead sees a modest fsync-overlap win — bench.py
+    #: ``pipeline_ab``, PERF.md Round 11 has both numbers honestly).
+    pipeline_depth: int | None = None
 
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient

@@ -10,12 +10,30 @@ Hard protocol errors (zero auth identity, UPDATE with zero id — the
 reference's fail-fast gRPC errors, grapevine.proto:60-64,95) are raised
 here on the host before anything reaches the device, exactly as the
 reference rejects them before the oblivious path.
+
+Pipelined round execution (PR 10, ROADMAP item 2): a round passes
+through four stages — assemble (validate + pack, lock-free), journal
+(sealed append + fsync, under the engine lock), dispatch (async jit
+enqueue with the donated state, under the same lock hold), resolve
+(device wait + demux, lock-free). ``handle_queries_async`` composes the
+first three and returns the :class:`PendingRound` whose ``resolve()`` is
+stage four; callers (``handle_queries`` here, the BatchScheduler, the
+chaos harness) keep up to ``config.pipeline_depth`` rounds in flight
+between dispatch and resolve, so round k+1's host assembly and journal
+fsync overlap round k's device execution — with two donated engine
+states rotating through XLA's buffer donation, steady-state cadence
+approaches ``max(host, fsync, device)`` instead of their sum. The
+durability ordering is depth-independent: journal-append (and its
+fsync barrier) strictly precedes the same round's dispatch, and rounds
+journal and dispatch inside one lock hold, so replay order is journal
+order — never completion order (OPERATIONS.md §16).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -252,6 +270,28 @@ class GrapevineEngine:
             expiry_sweep, static_argnums=(0,), donate_argnums=(1,)
         )
         self._lock = threading.Lock()
+        #: resolved round-pipeline depth: the max dispatched-but-
+        #: unresolved rounds a driver keeps in flight (config.py knob;
+        #: module docstring). Deliberately NOT part of EngineConfig —
+        #: the checkpoint/journal fingerprint must not cover it, because
+        #: a journal written at depth 2 replays bit-identically on a
+        #: depth-1 engine (replay order is journal order at every
+        #: depth; tests/test_pipeline.py pins the cross-depth restore).
+        #: Auto: 2 on TPU backends (the device round is the long pole —
+        #: overlapping host work and the journal fsync behind it is the
+        #: whole win, priced on-chip by tools/tpu_capture.py
+        #: ``pipeline_perf``), 1 elsewhere — on a host-bound CPU the
+        #: extra in-flight round buys no overlap but costs up to one
+        #: full device round of open-loop commit latency (measured:
+        #: PERF.md Round 11; the vphases/sort flip-on-evidence playbook)
+        if self.config.pipeline_depth is not None:
+            self.pipeline_depth = self.config.pipeline_depth
+        else:
+            from ..config import TPU_BACKENDS
+
+            self.pipeline_depth = (
+                2 if jax.default_backend() in TPU_BACKENDS else 1
+            )
         self.metrics = EngineMetrics()
         #: streaming obliviousness auditor (obs/leakmon.py), attached by
         #: the serving layer when --leakmon is on; None = no monitoring
@@ -528,26 +568,51 @@ class GrapevineEngine:
         and slot-ordered). This is intended — it is exactly the
         interleaving concurrent gRPC clients produce through the
         scheduler, and the soak suite exercises it; a caller needing a
-        multi-round transaction must hold its own lock."""
+        multi-round transaction must hold its own lock.
+
+        Multi-chunk calls pipeline: up to ``pipeline_depth`` chunks stay
+        dispatched-but-unresolved, so chunk k+1's pack + journal fsync
+        overlap chunk k's device execution. Responses come back in
+        request order regardless (rounds resolve in dispatch order), and
+        depth 1 is bit-for-bit the serial resolve-before-next-dispatch
+        program."""
         for r in reqs:  # all-or-nothing: nothing commits if any is malformed
             validate_request(r)
         out: list[QueryResponse] = []
         bs = self.ecfg.batch_size
-        for i in range(0, len(reqs), bs):
-            out.extend(self.handle_queries_async(reqs[i : i + bs], now).resolve())
+        depth = max(1, self.pipeline_depth)
+        ledger: deque[PendingRound] = deque()
+        # resolve everything dispatched even when a dispatch or an
+        # earlier resolve raises — an abandoned PendingRound would leave
+        # its journal/leakmon/metrics hand-off forever unaccounted. The
+        # FIRST exception stays the primary one; the drain never stops
+        # on a failed resolve.
+        exc0: BaseException | None = None
+        try:
+            for i in range(0, len(reqs), bs):
+                while len(ledger) >= depth:
+                    out.extend(ledger.popleft().resolve())
+                ledger.append(
+                    self.handle_queries_async(reqs[i : i + bs], now)
+                )
+        except BaseException as exc:
+            exc0 = exc
+        while ledger:
+            try:
+                out.extend(ledger.popleft().resolve())
+            except BaseException as exc:
+                if exc0 is None:
+                    exc0 = exc
+        if exc0 is not None:
+            raise exc0
         return out
 
-    def handle_queries_async(
-        self, reqs: list[QueryRequest], now: int
-    ) -> "PendingRound":
-        """Dispatch one round without waiting for the device.
+    # -- the staged round pipeline (module docstring; OPERATIONS.md §16)
 
-        JAX dispatch is asynchronous: this returns as soon as the round
-        is enqueued, so a caller (the scheduler) can collect and verify
-        the *next* round while the device executes this one — the
-        dispatch/compute overlap PERF.md's cost model calls for. Rounds
-        are serialized by the engine lock; ``resolve()`` blocks for the
-        results."""
+    def _assemble_round(self, reqs: list[QueryRequest], now: int) -> dict:
+        """Stage 1 — assemble: validate + pack the wire records into the
+        fixed-size device batch. Lock-free host work; under the
+        pipelined scheduler this runs while earlier rounds execute."""
         for r in reqs:
             validate_request(r)
         if int(now) <= 0:
@@ -555,33 +620,81 @@ class GrapevineEngine:
         bs = self.ecfg.batch_size
         if len(reqs) > bs:
             raise ValueError("async path is one round at a time")
+        return pack_batch(reqs, bs, now)
+
+    def _journal_round(self, batch: dict, n_real: int, spans: dict) -> None:
+        """Stage 2 — journal: sealed append + fsync barrier (per
+        ``journal_fsync_every``) BEFORE the round may dispatch — the
+        crash-safety contract. Runs under the engine lock in the same
+        hold as stage 3, so journal order IS dispatch order and replay
+        order is journal order at every pipeline depth. With a round
+        already in flight (pipeline_depth=2) the fsync overlaps its
+        device execution instead of serializing with it — the PR-10
+        point; the "journal" series isolates what it costs."""
+        if self.durability is not None:
+            t_j0 = time.perf_counter()
+            self.durability.append_round(batch, n_real)
+            j_s = time.perf_counter() - t_j0
+            self.metrics.observe_phase("journal", j_s)
+            spans["journal"] = (t_j0, j_s)
+        if faults.active():
+            # the pipelined crash window: this round is durable (its
+            # frame is fsynced) but not yet dispatched, while the
+            # previous round may still be mid-flight on the device
+            faults.crash("round.pre_dispatch")
+
+    def _dispatch_round(self, batch: dict):
+        """Stage 3 — dispatch: enqueue the jit'd round on the device and
+        chain ``self.state`` onto its (donated) output. JAX dispatch is
+        asynchronous — this returns at enqueue, and with two rounds in
+        flight XLA rotates two donated state buffers. Same lock hold as
+        stage 2 (see there)."""
+        t0 = time.perf_counter()
+        self.state, resp, transcript = self._step(
+            self.ecfg, self.state, batch
+        )
+        return t0, resp, transcript
+
+    def handle_queries_async(
+        self, reqs: list[QueryRequest], now: int
+    ) -> "PendingRound":
+        """Dispatch one round without waiting for the device.
+
+        Composes pipeline stages 1-3 (assemble → journal+fsync →
+        dispatch) and returns the round's handle; ``resolve()`` is stage
+        4. JAX dispatch is asynchronous: this returns as soon as the
+        round is enqueued, so a caller (the scheduler, or
+        ``handle_queries`` on a multi-chunk call) can assemble, verify,
+        and journal the *next* round — and keep up to ``pipeline_depth``
+        rounds un-resolved — while the device executes this one (the
+        dispatch/compute overlap PERF.md's cost model calls for).
+        Rounds are serialized by the engine lock; ``resolve()`` blocks
+        for the results."""
+        batch = self._assemble_round(reqs, now)
         lm = self.leakmon
         with self._lock:
-            # "dispatch" = host pack + async device enqueue (JAX returns
-            # at enqueue; the device round itself lands in "evict").
-            # With durability on it also spans the journal barrier —
-            # append-before-dispatch is the crash-safety contract, and
-            # its fsync is genuinely part of the commit latency (the
-            # "journal" series isolates it).
+            # "dispatch" = async device enqueue (JAX returns at
+            # enqueue; the device round itself lands in "evict"); the
+            # host pack now runs in stage 1 OUTSIDE the lock, where the
+            # pipeline can overlap it. With durability on, dispatch
+            # also spans the journal barrier — append-before-dispatch
+            # is the crash-safety contract, and its fsync is genuinely
+            # part of the commit latency (the "journal" series
+            # isolates it).
             t_d0 = time.perf_counter()
             spans: dict = {}
             with self.metrics.time_phase("dispatch"):
-                batch = pack_batch(reqs, bs, now)
-                if self.durability is not None:
-                    t_j0 = time.perf_counter()
-                    self.durability.append_round(batch, len(reqs))
-                    j_s = time.perf_counter() - t_j0
-                    self.metrics.observe_phase("journal", j_s)
-                    spans["journal"] = (t_j0, j_s)
-                t0 = time.perf_counter()
-                self.state, resp, transcript = self._step(
-                    self.ecfg, self.state, batch
-                )
+                self._journal_round(batch, len(reqs), spans)
+                t0, resp, transcript = self._dispatch_round(batch)
             if faults.active():
                 faults.crash("round.post_dispatch")
             if self.durability is not None and self.durability.should_checkpoint():
                 # blocks this round's slot until the sealed state is on
-                # disk — the RTO/RPO trade --checkpoint-every-rounds buys
+                # disk — the RTO/RPO trade --checkpoint-every-rounds
+                # buys. state_to_bytes waits for every dispatched round
+                # (this one included), so the sealed state is exactly
+                # the journal's seq even with the pipeline full — the
+                # checkpoint is itself a pipeline barrier.
                 t_c0 = time.perf_counter()
                 with self.metrics.time_phase("checkpoint"):
                     self.durability.checkpoint(self.state)

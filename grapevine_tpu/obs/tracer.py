@@ -210,6 +210,26 @@ class RoundTracer:
         with self._lock:
             return self._bubble_locked()
 
+    def span_durations_ms(self, name: str) -> list[float]:
+        """Non-zero durations (ms) of one phase span across the
+        retained ledgers, oldest first — the A/B tooling's accessor
+        (bench.py ``pipeline_ab``, tools/tpu_capture.py
+        ``pipeline_perf``), shared so the banked journal-span
+        methodology can never diverge between the two. Phase-level by
+        construction: the ring holds nothing finer."""
+        if name not in ALLOWED_SPAN_NAMES:
+            raise ValueError(
+                f"{name!r} is not a round span "
+                f"(allowed: {sorted(ALLOWED_SPAN_NAMES)})"
+            )
+        with self._lock:
+            entries = self._recent_locked(self.capacity)
+        return [
+            e["spans"][name][1] * 1e3
+            for e in entries
+            if e["spans"].get(name, (0.0, 0.0))[1] > 0.0
+        ]
+
     # -- export ---------------------------------------------------------
 
     #: rounds alternate across this many lanes per track: the pipelined

@@ -89,6 +89,22 @@ def build_parser() -> argparse.ArgumentParser:
         "+ flip guidance). Device-owning roles only — the frontend "
         "never touches a tree",
     )
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        choices=[1, 2],
+        default=None,
+        help="round-pipeline depth (engine/batcher.py): max dispatched-"
+        "but-unresolved engine rounds in flight. 2 = while round k "
+        "executes on the device, round k+1 is assembled, verified, and "
+        "its journal frame fsynced — steady-state cadence approaches "
+        "max(host, fsync, device) and p99 commit latency stops paying "
+        "the fsync; 1 = the serial program, bit for bit (responses and "
+        "state are bit-identical either way, and replay order is "
+        "journal order at every depth — OPERATIONS.md §16). Unset = "
+        "auto: 2 on TPU backends, 1 elsewhere. Device-owning roles "
+        "only — the frontend has no round pipeline",
+    )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument(
         "--identity-seed",
@@ -280,11 +296,12 @@ _DURABILITY_FLAGS = {"state_dir", "checkpoint_every_rounds",
 _TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
                     "profile_enable"}
 
-#: device-engine geometry knobs: only roles that build an engine take
-#: them — a frontend supplying --posmap-impl or --tree-top-cache-levels
-#: would silently configure nothing (its engine lives in another
-#: process)
-_ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels"}
+#: device-engine geometry/execution knobs: only roles that build an
+#: engine take them — a frontend supplying --posmap-impl,
+#: --tree-top-cache-levels, or --pipeline-depth would silently
+#: configure nothing (its engine lives in another process)
+_ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels",
+                      "pipeline_depth"}
 
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
@@ -409,6 +426,7 @@ def main(argv=None) -> int:
         batch_size=args.batch_size,
         posmap_impl=args.posmap_impl,
         tree_top_cache_levels=args.tree_top_cache_levels,
+        pipeline_depth=args.pipeline_depth,
     )
     identity = None
     if args.identity_seed:

@@ -13,12 +13,21 @@ signatures are checked with ONE random-linear-combination multi-scalar
 multiplication (session/ristretto.py:batch_verify — SURVEY.md §2b
 "consider batch verify"); only a failing round pays per-item verification
 to identify offenders, which are rejected without reaching the engine.
+
+The collector is a staged pipeline (PR 10): it keeps up to
+``pipeline_depth`` dispatched rounds in a bounded in-flight ledger and
+settles them oldest-first, so at depth 2 round k+2's collection window,
+batch verification, and journal fsync all overlap rounds k and k+1 on
+the device (engine/batcher.py module docstring has the stage contract;
+OPERATIONS.md §16 the ordering/durability argument). Depth 1 is
+bit-for-bit the pre-PR-10 dispatch-then-settle loop.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 
 from ..engine.batcher import GrapevineEngine
@@ -49,11 +58,26 @@ class BatchScheduler:
         clock=None,
         scheme=None,
         restart_on_crash: bool = False,
+        pipeline_depth: int | None = None,
     ):
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
         self.idle_gap = idle_gap_ms / 1000.0
         self.clock = clock or (lambda: int(time.time()))
+        #: round-pipeline depth — max dispatched-but-unsettled rounds
+        #: the collector keeps in flight (the bounded in-flight ledger;
+        #: engine/batcher.py module docstring, OPERATIONS.md §16).
+        #: Default: the engine's resolved ``config.pipeline_depth``
+        #: (stub engines in tests have none → 1, the serial program);
+        #: the explicit parameter exists for the bench's depth A/B.
+        depth = (
+            pipeline_depth
+            if pipeline_depth is not None
+            else getattr(engine, "pipeline_depth", 1)
+        )
+        if int(depth) < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {depth}")
+        self.pipeline_depth = int(depth)
         #: signature scheme module (sign/verify/batch_verify); default is
         #: the reference-compatible sr25519 (session/schnorrkel.py)
         self.scheme = scheme or schnorrkel
@@ -211,14 +235,40 @@ class BatchScheduler:
 
     def _run_inner(self):
         bs = self.engine.ecfg.batch_size
-        prev = None  # in-flight (PendingRound, live futures) — pipeline depth 1
+        depth = self.pipeline_depth
+        #: the bounded in-flight ledger: (PendingRound, live futures,
+        #: monotonic dispatch time) in dispatch order. After a dispatch
+        #: the collector settles the ledger down to ``depth`` rounds, so
+        #: at depth 2 round k+2's collection window, verification, and
+        #: journal fsync all run while rounds k and k+1 are still on the
+        #: device; at depth 1 the sequence is bit-for-bit the pre-PR-10
+        #: dispatch-then-settle loop. The bound is enforced AFTER
+        #: dispatch on purpose (dispatch-then-settle IS the depth-1
+        #: legacy ordering): depth+1 rounds are transiently dispatched-
+        #: but-unresolved for the duration of each settle wait — size
+        #: device resp/transcript buffer residency as depth+1 rounds,
+        #: not depth (config.py knob docstring, OPERATIONS.md §16).
+        #: Rounds always settle oldest-first (= dispatch = journal
+        #: order), so responses, tracer ledgers, and leakmon hand-offs
+        #: stay in round order at every depth.
+        ledger: deque = deque()
+
+        def settle_head():
+            pending_h, live_h, t_h = ledger.popleft()
+            # the round being settled is the oldest in flight — its
+            # dispatch time anchors the stall signal while we block
+            self._inflight_since = t_h
+            self._settle(pending_h, live_h)
+            self._crash_streak = 0  # a settled round = recovered
+            self._inflight_since = ledger[0][2] if ledger else None
+
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
-                    if prev is not None:
-                        break  # drain the in-flight round before sleeping
+                    if ledger:
+                        break  # drain the in-flight pipeline, then sleep
                     self._cv.wait()
-                if self._closed and not self._queue and prev is None:
+                if self._closed and not self._queue and not ledger:
                     return
                 chunk = []
                 if self._queue:
@@ -263,11 +313,11 @@ class BatchScheduler:
                             self.metrics.record_stall()
 
             # everything the death-guard must fail if we crash from here:
-            # the round still in flight on the device plus the chunk just
-            # popped off the queue (no longer reachable from _queue)
-            self._inflight = ([f for _, f in prev[1]] if prev else []) + [
-                f for _, _, f, _ in chunk
-            ]
+            # the rounds still in flight on the device plus the chunk
+            # just popped off the queue (no longer reachable from _queue)
+            self._inflight = [
+                f for _, lv, _ in ledger for _, f in lv
+            ] + [f for _, _, f, _ in chunk]
             pending, live = (None, [])
             if chunk:
                 t_v0 = time.monotonic()
@@ -287,7 +337,7 @@ class BatchScheduler:
                         pending = self.engine.handle_queries_async(
                             reqs, self.clock()
                         )
-                        self._inflight_since = time.monotonic()
+                        t_disp = time.monotonic()
                         # collector-side spans + the oldest op's enqueue
                         # stamp ride the round handle itself, so the
                         # tracer/SLO pair them with THIS round even
@@ -315,13 +365,19 @@ class BatchScheduler:
                             if not fut.done():
                                 fut.set_exception(exc)
                         live = []
-            if prev is not None:
-                self._settle(*prev)
-                self._crash_streak = 0  # a settled round = recovered
-            if pending is None:
-                # nothing left on the device (prev, if any, just settled)
-                self._inflight_since = None
-            prev = (pending, live) if pending is not None else None
+            if pending is not None:
+                ledger.append((pending, live, t_disp))
+                self._inflight_since = ledger[0][2]
+                # the pipeline bound: settle oldest-first down to depth,
+                # so the NEXT collection window opens with exactly
+                # ``depth`` rounds overlapping it
+                while len(ledger) > depth:
+                    settle_head()
+            elif ledger:
+                # nothing dispatched this pass (idle tail, drain, or an
+                # all-rejected chunk): settle the oldest round so its
+                # clients are answered promptly and close() can drain
+                settle_head()
 
     def _verify_chunk(self, chunk):
         """Batch signature verification; returns surviving (req, fut)."""

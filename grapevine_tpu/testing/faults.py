@@ -29,6 +29,11 @@ Instrumented points (grep ``faults.crash`` / ``faults.hit``):
 - ``checkpoint.tmp.torn``      half the sealed tmp file written, then die
 - ``checkpoint.pre_rename``    tmp complete, before the atomic rename
 - ``checkpoint.post_rename``   checkpoint live, before journal roll/prune
+- ``round.pre_dispatch``       round journaled + fsynced, before its device
+                               dispatch — under the pipelined engine
+                               (pipeline_depth=2) this is the window where
+                               round k+1 is durable but round k is still
+                               mid-flight on the device
 - ``round.post_dispatch``      round journaled + dispatched, before resolve
 """
 
@@ -50,6 +55,7 @@ ALL_POINTS = (
     "checkpoint.tmp.torn",
     "checkpoint.pre_rename",
     "checkpoint.post_rename",
+    "round.pre_dispatch",
     "round.post_dispatch",
 )
 

@@ -49,3 +49,26 @@ def test_randomized_kill_trials_recover_bit_identical():
     args = chaos.parse_args(["--events", "18", "--seed", "77"])
     failures = chaos.run_trials(6, args)
     assert not failures, "\n".join(failures)
+
+
+def test_pipelined_kill_trials_recover_bit_identical():
+    """PR-10 chaos coverage: ``--pipeline-depth 2`` keeps a round
+    mid-flight on the device while the next one journals + fsyncs, and
+    kills land (a) between journal-fsync(k+1) and dispatch(k+1)
+    (``round.pre_dispatch``), (b) mid-flight of round k with k+1
+    dispatched behind it (``round.post_dispatch``), (c) at the fsync
+    barrier itself, the torn-frame window, and a randomized wall-clock
+    point. The oracle is the SERIAL depth-1 program, so recovery being
+    bit-identical proves both the crash contract (replay order = journal
+    order, never completion order) and depth bit-equivalence at once,
+    with leakmon PASS on the recovered engine."""
+    chaos = _load_chaos()
+
+    args = chaos.parse_args(
+        ["--events", "18", "--seed", "99", "--pipeline-depth", "2"]
+    )
+    failures = chaos.run_trials(0, args, modes=[
+        "round.pre_dispatch", "round.post_dispatch",
+        "journal.append.post_fsync", "journal.append.torn", "timer",
+    ])
+    assert not failures, "\n".join(failures)

@@ -37,6 +37,10 @@ def _check(argv):
     # even at the explicit "off" value
     ["--role", "frontend", "--tree-top-cache-levels", "4"],
     ["--role", "frontend", "--tree-top-cache-levels", "0"],
+    # the round pipeline runs on the device-owning role (ISSUE 10
+    # satellite) — rejected even at the explicit serial value
+    ["--role", "frontend", "--pipeline-depth", "2"],
+    ["--role", "frontend", "--pipeline-depth", "1"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -71,6 +75,10 @@ def test_misapplied_flags_rejected(argv):
     ["--role", "mono", "--tree-top-cache-levels", "4"],
     ["--role", "engine", "--engine-listen", "127.0.0.1:0",
      "--tree-top-cache-levels", "0"],
+    # …and the round-pipeline depth (ISSUE 10)
+    ["--role", "mono", "--pipeline-depth", "2"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--pipeline-depth", "1"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

@@ -1,0 +1,366 @@
+"""Pipelined round execution (PR 10): depth knob validation, depth-1 ↔
+depth-2 bit-identity, journal-order/durability invariants across the
+pipeline, and span pairing with two rounds genuinely in flight.
+
+The contract under test (engine/batcher.py module docstring,
+OPERATIONS.md §16):
+
+- ``pipeline_depth=1`` is bit-for-bit the serial pre-PR-10 program;
+  depth 2 overlaps round k+1's assembly + journal fsync with round k's
+  device execution and STILL produces bit-identical responses and final
+  state (the engine round is deterministic given (state, batch), and
+  neither the dispatch ledger nor the deferred resolve touches either).
+- Journal order is dispatch order at every depth, and a journal written
+  at depth 2 replays bit-identically on a depth-1 engine: the depth is
+  an execution knob, not geometry — the checkpoint fingerprint must not
+  cover it.
+- Tracer ledgers pair spans with the right round even with two rounds
+  in flight (PendingRound.note_span), and /trace stays Perfetto-valid
+  (complete events within one tid disjoint or nested).
+
+Depth-2 crash coverage (kill between fsync and dispatch, mid-flight of
+round k) lives in tests/test_chaos_recovery.py / tools/chaos_run.py
+``--pipeline-depth 2``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from grapevine_tpu.config import DurabilityConfig, GrapevineConfig
+from grapevine_tpu.engine.batcher import GrapevineEngine
+from grapevine_tpu.engine.checkpoint import state_to_bytes
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+NOW0 = 1_700_000_000
+
+
+def _toy_config(pipeline_depth, **kw):
+    base = dict(
+        max_messages=64, max_recipients=8, mailbox_cap=4,
+        batch_size=4, stash_size=64, bucket_cipher_rounds=0,
+    )
+    base.update(kw)
+    return GrapevineConfig(pipeline_depth=pipeline_depth, **base)
+
+
+def _key(n: int) -> bytes:
+    return bytes([n & 0xFF, (n >> 8) & 0xFF, n ^ 0x5A]) + b"\x01" * 29
+
+
+def _campaign_reqs(rng: random.Random, n: int) -> list[QueryRequest]:
+    """Randomized CREATE/READ/DELETE mix, schedule a pure function of
+    the rng (the chaos-harness shape: zero-id pops, no response-derived
+    inputs)."""
+    out = []
+    for _ in range(n):
+        c = rng.random()
+        if c < 0.6:
+            rt, rcp = C.REQUEST_TYPE_CREATE, _key(rng.randrange(1, 6))
+        elif c < 0.9:
+            rt, rcp = C.REQUEST_TYPE_READ, C.ZERO_PUBKEY
+        else:
+            rt, rcp = C.REQUEST_TYPE_DELETE, C.ZERO_PUBKEY
+        out.append(QueryRequest(
+            request_type=rt,
+            auth_identity=_key(rng.randrange(1, 6)),
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID,
+                recipient=rcp,
+                payload=bytes([rng.randrange(256)]) * C.PAYLOAD_SIZE,
+            ),
+        ))
+    return out
+
+
+def _run_campaign(engine, seed=7, calls=12, max_reqs=12, expire_every=5):
+    """Drive multi-chunk handle_queries calls (up to 3 rounds per call —
+    the path that actually pipelines) plus expiry sweeps; returns the
+    response-stream hash."""
+    rng = random.Random(seed)
+    h = hashlib.sha256()
+    for i in range(calls):
+        if expire_every and i % expire_every == expire_every - 1:
+            engine.expire(NOW0 + i, period=10_000)
+            continue
+        reqs = _campaign_reqs(rng, rng.randrange(1, max_reqs))
+        for r in engine.handle_queries(reqs, NOW0 + i):
+            h.update(r.pack())
+    return h.hexdigest()
+
+
+def _state_hash(engine) -> str:
+    return hashlib.sha256(
+        state_to_bytes(engine.ecfg, engine.state)
+    ).hexdigest()
+
+
+# -- knob validation + resolution ---------------------------------------
+
+
+def test_pipeline_depth_validation():
+    for bad in (0, 3, -1, "2"):
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            GrapevineConfig(pipeline_depth=bad)
+    for ok in (None, 1, 2):
+        GrapevineConfig(pipeline_depth=ok)
+
+
+def test_scheduler_rejects_bad_depth_and_defaults_serial_for_stubs():
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    class _Stub:
+        class ecfg:
+            batch_size = 4
+
+        metrics = None
+
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        BatchScheduler(_Stub(), pipeline_depth=0)
+    s = BatchScheduler(_Stub())
+    try:
+        # no resolved engine depth on the stub → the serial program
+        assert s.pipeline_depth == 1
+    finally:
+        s.close()
+
+
+# -- bit-identity + durability across depths ----------------------------
+
+
+def test_depth2_bit_identical_and_journal_replays_on_depth1(tmp_path):
+    """One campaign, three engines:
+
+    1. depth 1, no durability — the serial oracle;
+    2. depth 2, durability on (fsync every round, checkpoints rolling
+       mid-campaign) — responses AND final state must equal (1) bit for
+       bit while rounds genuinely overlap;
+    3. a depth-1 engine recovered from (2)'s state dir — the journal
+       a pipelined engine wrote must replay bit-identically on a serial
+       engine (replay order is journal order, and the fingerprint does
+       not cover the depth; a knob change must never strand a fleet's
+       checkpoints)."""
+    e1 = GrapevineEngine(_toy_config(1), seed=3)
+    assert e1.pipeline_depth == 1
+    resp1 = _run_campaign(e1)
+    state1 = _state_hash(e1)
+
+    dcfg = DurabilityConfig(
+        state_dir=str(tmp_path / "d2"), checkpoint_every_rounds=10,
+        journal_fsync_every=1,
+    )
+    e2 = GrapevineEngine(_toy_config(2), seed=3, durability=dcfg)
+    assert e2.pipeline_depth == 2
+    resp2 = _run_campaign(e2)
+    state2 = _state_hash(e2)
+    assert resp2 == resp1, "depth-2 responses diverge from the serial run"
+    assert state2 == state1, "depth-2 final state diverges"
+    seq2 = e2.durability.seq
+    assert seq2 > 10, "campaign too short to roll a checkpoint"
+    e2.close()
+
+    e3 = GrapevineEngine(_toy_config(1), seed=3, durability=dcfg)
+    assert _state_hash(e3) == state2, (
+        "depth-1 recovery from a depth-2 journal is not bit-identical"
+    )
+    assert e3.durability.seq == seq2
+    e3.close()
+
+
+def test_depth2_journal_order_is_dispatch_order(tmp_path):
+    """Two rounds dispatched back-to-back with NEITHER resolved: the
+    journal must hold round A's frame before round B's (replay order =
+    journal order = dispatch order, never completion/resolve order)."""
+    from grapevine_tpu.engine.journal import BatchJournal, KIND_ROUND
+
+    dcfg = DurabilityConfig(state_dir=str(tmp_path / "ord"))
+    engine = GrapevineEngine(_toy_config(2), seed=0, durability=dcfg)
+
+    def mk(pay):
+        return QueryRequest(
+            request_type=C.REQUEST_TYPE_CREATE,
+            auth_identity=_key(1),
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID, recipient=_key(2),
+                payload=bytes([pay]) * C.PAYLOAD_SIZE,
+            ),
+        )
+
+    pa = engine.handle_queries_async([mk(0xAA), mk(0xAA)], NOW0)
+    pb = engine.handle_queries_async([mk(0xBB)], NOW0 + 1)
+    # both journaled + dispatched, neither resolved — the depth-2 window
+    # resolve out of order on purpose: the journal must not care
+    rb = pb.resolve()
+    ra = pa.resolve()
+    assert [r.status_code for r in ra + rb] == [C.STATUS_CODE_SUCCESS] * 3
+    engine.close()
+
+    j = BatchJournal(dcfg.state_dir, engine.durability.root_key,
+                     engine.ecfg, fsync_every=1)
+    recs = list(j.replay(after_seq=0))
+    assert [r.kind for r in recs] == [KIND_ROUND, KIND_ROUND]
+    assert [r.n_real for r in recs] == [2, 1]
+    assert int(np.asarray(recs[0].batch["payload"])[0, 0]) & 0xFF == 0xAA
+    assert int(np.asarray(recs[1].batch["payload"])[0, 0]) & 0xFF == 0xBB
+
+
+# -- two-in-flight observability ----------------------------------------
+
+
+def _tid_events_disjoint_or_nested(events):
+    """Perfetto's complete-event contract: within one tid, X events
+    sorted by ts must nest or stay disjoint (the test_trace_slo lane
+    rule, applied to REAL overlapping rounds). Tolerance of 2 µs: ts
+    and dur are independently floor()ed to µs by the export, so a child
+    ending at its parent's edge can land 1 µs past it — real pipeline
+    mispairings overlap by whole phase durations (ms), never 2 µs."""
+    eps = 2
+    by_tid: dict = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_tid.setdefault(ev["tid"], []).append(
+                (ev["ts"], ev["ts"] + ev["dur"]))
+    for tid, spans in by_tid.items():
+        # equal starts: the longer (outer) span must come first or the
+        # nesting walk reads its own parent as a violation
+        spans.sort(key=lambda p: (p[0], -p[1]))
+        stack = []
+        for start, end in spans:
+            while stack and start >= stack[-1] - eps:
+                stack.pop()
+            if stack and end > stack[-1] + eps:
+                return False, tid
+            stack.append(end)
+    return True, None
+
+
+def test_two_inflight_rounds_pair_spans_and_trace_stays_valid():
+    """Depth-2 directed check: with rounds A and B simultaneously in
+    flight, (a) each tracer ledger carries ITS round's collector spans
+    (note_span rides the handle — no cross-round staging mispairing),
+    (b) the evict span is the true host-blocked wait actually measured
+    at resolve (what the bubble ratio derives from), and (c) /trace
+    Chrome JSON stays Perfetto-valid with overlapping rounds split
+    across the two lanes."""
+    from grapevine_tpu.obs.tracer import RoundTracer
+
+    engine = GrapevineEngine(_toy_config(2), seed=1)
+    tracer = RoundTracer(capacity=16, registry=engine.metrics.registry)
+    engine.attach_tracer(tracer)
+
+    def mk(i):
+        return QueryRequest(
+            request_type=C.REQUEST_TYPE_CREATE,
+            auth_identity=_key(i + 1),
+            auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+            record=RequestRecord(
+                msg_id=C.ZERO_MSG_ID, recipient=_key(1),
+                payload=b"\x07" * C.PAYLOAD_SIZE,
+            ),
+        )
+
+    # collector-side markers stamped onto each round's own handle; the
+    # windows sit well clear of the real dispatch spans (a collection
+    # window always precedes its round's lock section)
+    pa = engine.handle_queries_async([mk(0)], NOW0)
+    pa.note_span("assembly", pa._t0 - 1.0, 0.001)  # round A's marker
+    pb = engine.handle_queries_async([mk(1)], NOW0 + 1)
+    pb.note_span("assembly", pb._t0 - 1.0, 0.002)  # round B's marker
+    # both dispatched, neither resolved: genuinely overlapping rounds
+    pa.resolve()
+    pb.resolve()
+
+    trace = tracer.chrome_trace()
+    entries = [ev for ev in trace["traceEvents"] if ev.get("ph") == "X"]
+    a_spans = {ev["name"]: ev for ev in entries if ev["args"]["seq"] == 1}
+    b_spans = {ev["name"]: ev for ev in entries if ev["args"]["seq"] == 2}
+    # (a) exact pairing: each ledger carries its own collector marker
+    assert a_spans["grapevine/assembly"]["dur"] == 1000
+    assert b_spans["grapevine/assembly"]["dur"] == 2000
+    # dispatch order preserved in the ledgers
+    assert (a_spans["grapevine/dispatch"]["ts"]
+            < b_spans["grapevine/dispatch"]["ts"])
+    # overlapping rounds land on different lanes (tids)
+    assert (a_spans["grapevine/dispatch"]["tid"]
+            != b_spans["grapevine/dispatch"]["tid"])
+    # (b) the bubble input is the true evict wait: both ledgers carry a
+    # finite non-negative evict span and the windowed ratio is in [0,1]
+    for spans in (a_spans, b_spans):
+        assert spans["grapevine/evict"]["dur"] >= 0
+    assert 0.0 <= tracer.bubble_ratio() <= 1.0
+    # (c) Perfetto validity under overlap
+    ok, tid = _tid_events_disjoint_or_nested(trace["traceEvents"])
+    assert ok, f"overlapping X events on tid {tid}"
+
+
+def test_scheduler_depth2_serves_and_drains():
+    """The pipelined scheduler end to end: concurrent closed-loop
+    clients are all served at depth 2 (the idle tail settles the ledger
+    — nobody waits on an un-popped pipeline), and close() drains the
+    in-flight rounds."""
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    engine = GrapevineEngine(
+        _toy_config(2, max_messages=256, max_recipients=32,
+                    mailbox_cap=16),
+        seed=0,
+    )
+    sched = BatchScheduler(engine, clock=lambda: NOW0)
+    assert sched.pipeline_depth == 2
+    errs: list = []
+
+    def client(i):
+        try:
+            for _ in range(5):
+                r = sched.submit(QueryRequest(
+                    request_type=C.REQUEST_TYPE_CREATE,
+                    auth_identity=_key(i + 1),
+                    auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                    record=RequestRecord(
+                        msg_id=C.ZERO_MSG_ID, recipient=_key(i % 5 + 1),
+                        payload=b"\x07" * C.PAYLOAD_SIZE,
+                    ),
+                ))
+                assert r.status_code == C.STATUS_CODE_SUCCESS
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs[0]
+    assert not any(t.is_alive() for t in threads)
+    sched.close()
+    assert not sched.worker_alive()
+    assert engine.metrics.snapshot()["real_ops"] == 20
+
+
+# -- heavier cross-impl pairs ride the slow bucket ----------------------
+
+
+@pytest.mark.slow
+def test_depth_pair_with_cipher_and_recursive_posmap():
+    """Depth-1 ↔ depth-2 bit-identity with the production trimmings on:
+    ChaCha8 bucket cipher, recursive position map, tree-top cache (the
+    toy auto), scan vphases — the full-stack pair the acceptance
+    criteria name."""
+    kw = dict(
+        max_messages=64, max_recipients=16, bucket_cipher_rounds=8,
+        posmap_impl="recursive", tree_top_cache_levels=2,
+    )
+    e1 = GrapevineEngine(_toy_config(1, **kw), seed=5)
+    e2 = GrapevineEngine(_toy_config(2, **kw), seed=5)
+    r1 = _run_campaign(e1, seed=21, calls=16)
+    r2 = _run_campaign(e2, seed=21, calls=16)
+    assert r1 == r2
+    assert _state_hash(e1) == _state_hash(e2)

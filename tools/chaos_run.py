@@ -49,7 +49,8 @@ MAX_RESTARTS = 60
 
 
 def _config(posmap_impl: str | None = None,
-            tree_top_cache_levels: int | None = None):
+            tree_top_cache_levels: int | None = None,
+            pipeline_depth: int | None = None):
     from grapevine_tpu.config import GrapevineConfig
 
     return GrapevineConfig(
@@ -57,6 +58,7 @@ def _config(posmap_impl: str | None = None,
         batch_size=4, stash_size=64, bucket_cipher_rounds=0,
         posmap_impl=posmap_impl,
         tree_top_cache_levels=tree_top_cache_levels,
+        pipeline_depth=pipeline_depth,
     )
 
 
@@ -107,17 +109,45 @@ def _resp_hash(resps) -> str:
 
 
 def _run_events(engine, events, start: int, progress=None):
-    """Drive ``events[start:]``; append ``seq hash`` progress lines."""
+    """Drive ``events[start:]``; append ``seq hash`` progress lines.
+
+    Pipelined per the engine's resolved ``pipeline_depth``: up to depth
+    rounds stay dispatched-but-unresolved ACROSS events (the engine's
+    async path with a bounded ledger — the scheduler's discipline), so
+    the journal/dispatch crash sites fire while earlier rounds are
+    genuinely mid-flight on the device. Rounds resolve oldest-first (=
+    dispatch = journal order); a crash loses only the progress lines of
+    rounds that never resolved, whose recovery the final-state hash
+    still fully covers. Depth 1 keeps the ledger empty at every event
+    boundary — the serial pre-PR-10 program, bit for bit."""
+    depth = max(1, getattr(engine, "pipeline_depth", 1))
+    ledger: list = []  # (event seq, PendingRound) in dispatch order
+
+    def settle_one():
+        seq, pending = ledger.pop(0)
+        h = _resp_hash(pending.resolve())
+        if progress is not None:
+            progress.write(f"{seq} {h}\n")
+            progress.flush()
+
     for i in range(start, len(events)):
         ev = events[i]
+        # the pipeline bound: at depth d, dispatch (or sweep — it runs
+        # synchronously under the same engine lock) with at most d-1
+        # rounds already in flight
+        while len(ledger) > depth - 1:
+            settle_one()
         if ev[0] == "round":
-            h = _resp_hash(engine.handle_queries(ev[2], ev[1]))
+            ledger.append(
+                (i + 1, engine.handle_queries_async(ev[2], ev[1]))
+            )
         else:
             engine.expire(ev[1], period=ev[2])
-            h = "sweep"
-        if progress is not None:
-            progress.write(f"{i + 1} {h}\n")
-            progress.flush()
+            if progress is not None:
+                progress.write(f"{i + 1} sweep\n")
+                progress.flush()
+    while ledger:
+        settle_one()
 
 
 def run_child(args) -> int:
@@ -132,7 +162,8 @@ def run_child(args) -> int:
         journal_fsync_every=1,
     )
     engine = GrapevineEngine(
-        _config(args.posmap_impl, args.tree_top_cache_levels),
+        _config(args.posmap_impl, args.tree_top_cache_levels,
+                args.pipeline_depth),
         seed=ENGINE_SEED, durability=dcfg,
     )
     monitor = EngineLeakMonitor.for_engine(
@@ -168,12 +199,18 @@ def run_child(args) -> int:
 
 def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None,
            tree_top_cache_levels: int | None = None):
-    """Uninterrupted in-process run: per-seq hashes + final state hash."""
+    """Uninterrupted in-process run: per-seq hashes + final state hash.
+
+    Always serial (pipeline_depth=1): the oracle is the pre-PR-10
+    resolve-before-next-dispatch program, so a ``--pipeline-depth 2``
+    chaos run proves depth-2 recovery bit-identical to the SERIAL ground
+    truth — pipelining equivalence and crash equivalence in one gate."""
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.engine.checkpoint import state_to_bytes
 
     engine = GrapevineEngine(
-        _config(posmap_impl, tree_top_cache_levels), seed=ENGINE_SEED
+        _config(posmap_impl, tree_top_cache_levels, pipeline_depth=1),
+        seed=ENGINE_SEED,
     )
     events = build_schedule(schedule_seed, n_events)
     hashes: dict[int, str] = {}
@@ -229,6 +266,8 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
         if args.tree_top_cache_levels is not None:
             child_cmd += ["--tree-top-cache-levels",
                           str(args.tree_top_cache_levels)]
+        if args.pipeline_depth is not None:
+            child_cmd += ["--pipeline-depth", str(args.pipeline_depth)]
         base_env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
@@ -360,6 +399,14 @@ def parse_args(argv):
     p.add_argument("--tree-top-cache-levels", type=int, default=None,
                    help="tree-top cache depth under test "
                    "(oram/path_oram.py); default = the engine auto")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   choices=[1, 2],
+                   help="round-pipeline depth under test (engine/"
+                   "batcher.py): 2 keeps a round mid-flight on the "
+                   "device while the next one journals + fsyncs — the "
+                   "crash windows PR 10 opened; the oracle always runs "
+                   "serial (depth 1), so the trial also proves depth "
+                   "bit-equivalence. Default = the engine auto")
     return p.parse_args(argv)
 
 

@@ -63,7 +63,7 @@ LOWER_BETTER = ("_ms", "_ms_per_op", "_s")
 #: signal) and are excluded from the metrics themselves
 GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
-                 "rounds", "slo_target_ms")
+                 "rounds", "slo_target_ms", "pipeline_depth")
 
 #: result fields that are neither geometry nor a directional metric.
 #: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
@@ -272,6 +272,18 @@ def selftest(factor: float) -> None:
                         mk_cap(200.0, 40.0, 2871.3)]), factor)
     assert n == 3 and not regs, (
         f"sentinel self-test: steady capacity series flagged ({regs})"
+    )
+    # pipeline_depth is GEOMETRY (PR 10): an explicit-depth rerun keys
+    # its own series — a depth-2 knee must never be graded against the
+    # auto/depth-1 baseline (they measure different programs), and the
+    # auto runs (no key at all) must stay one continuous series
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 / (factor * 4.0), 40.0 * factor * 4.0, 3250.7)
+    b["configs"]["load_scenarios"]["pipeline_depth"] = 2
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: a depth-keyed capacity line was compared "
+        "against the auto-depth baseline"
     )
 
 

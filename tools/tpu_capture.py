@@ -675,6 +675,97 @@ def stage_load_perf(cap, args):
     )
 
 
+def stage_pipeline_perf(cap, args):
+    """Round-pipeline depth A/B on the real device round (PR 10; the
+    ROADMAP item-2 decision number). For each ``pipeline_depth`` in
+    {1, 2}: an engine with durability ON (journal fsync every round —
+    the barrier the pipeline is supposed to hide) serves a steady
+    open-loop stream through the production scheduler; banked per arm:
+    achieved throughput, commit p50/p99, the measured journal-span
+    stats, and the bubble ratio UNDER that load. On a device-bound
+    round the depth-2 arm should approach pure device cadence with the
+    fsync fully overlapped; if the two arms tie, the round is so
+    host-bound that the pipeline has nothing to hide behind — either
+    way this is the number that decides the device default."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from grapevine_tpu.config import DurabilityConfig, GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ScenarioRunner,
+        calibrate_unloaded_round,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    cl, b, dur = (14, 16, 4.0) if args.quick else (18, 256, 10.0)
+    tmp = tempfile.mkdtemp(prefix="gv-pipeline-perf-")
+    out = {"capacity_log2": cl, "batch": b}
+    try:
+        est = None
+        for depth in (1, 2):
+            cfg = GrapevineConfig(
+                max_messages=1 << cl, max_recipients=1 << 10,
+                batch_size=b, pipeline_depth=depth,
+            )
+            dcfg = DurabilityConfig(
+                state_dir=os.path.join(tmp, f"d{depth}"),
+                checkpoint_every_rounds=1 << 20,
+                journal_fsync_every=1,
+            )
+            engine = GrapevineEngine(cfg, durability=dcfg)
+            # calibrate EVERY arm (not just the first): the call warms
+            # this engine's own jit wrapper, so neither arm pays its
+            # first compile/trace inside the measured window — the
+            # bench_pipeline_ab warm-up discipline. Only the FIRST
+            # arm's estimate sets the offered rate, so both arms are
+            # offered the same absolute stream and the A/B compares
+            # depths, not draws.
+            t_round, est_arm, _ = calibrate_unloaded_round(
+                engine, 1_700_000_000)
+            if est is None:
+                est = est_arm
+                out["calibrated_round_ms"] = round(t_round * 1e3, 2)
+            # tracer attached AFTER calibration: the ring (and the
+            # journal-span stats below) must cover the loaded run only,
+            # symmetrically for both arms
+            tracer = RoundTracer(capacity=2048,
+                                 registry=engine.metrics.registry)
+            engine.attach_tracer(tracer)
+            sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+            try:
+                runner = ScenarioRunner(sched, n_idents=64,
+                                        settle_timeout_s=180.0)
+                res = runner.run(steady_poisson(0.6 * est, dur, seed=29))
+            finally:
+                sched.close()
+                engine.close()
+            trace = tracer.chrome_trace()
+            j_ms = tracer.span_durations_ms("journal")
+            s = res.summary()
+            out[f"depth{depth}"] = {
+                "achieved_ops_per_sec": s.get("achieved_ops_per_sec"),
+                "p99_commit_ms": s.get("p99_commit_ms"),
+                "p50_commit_ms": s.get("p50_commit_ms"),
+                "bubble_ratio_under_load":
+                    trace["otherData"]["bubble_ratio"],
+                "journal_p99_ms": round(float(np.percentile(
+                    j_ms, 99, method="higher")), 3) if j_ms else None,
+                "rounds": trace["otherData"]["rounds_recorded_total"],
+            }
+        d1, d2 = out["depth1"], out["depth2"]
+        if d1["p99_commit_ms"] and d2["p99_commit_ms"]:
+            out["p99_delta_ms_d1_minus_d2"] = round(
+                d1["p99_commit_ms"] - d2["p99_commit_ms"], 2)
+        cap.emit("pipeline_perf", **out)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
@@ -692,6 +783,10 @@ STAGES = [
     # item-2 decision input (more valuable than the remaining A/Bs if
     # the window closes here)
     ("load_perf", stage_load_perf, 1200),
+    # pipeline_perf right after load_perf: same geometry family (cached
+    # compiles) and the depth A/B + under-load bubble is the other half
+    # of the ROADMAP-item-2 decision pair
+    ("pipeline_perf", stage_pipeline_perf, 1200),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
