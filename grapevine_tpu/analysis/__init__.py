@@ -1,0 +1,41 @@
+"""Static obliviousness + concurrency analysis (ISSUE 12).
+
+Two prongs, one package:
+
+- :mod:`oblint` — jaxpr-level taint-propagation analyzer proving that no
+  gather/scatter index, cond/while predicate, dynamic-slice start, or
+  host callback operand in a traced engine round is secret-derived,
+  modulo an explicit reviewed allowlist (:mod:`allowlist`) of
+  oblivious-by-construction sites. Shared jaxpr-walking/census helpers
+  (:mod:`jaxpr_walk`) back both this analyzer and the legacy CI gates
+  (tools/check_posmap_oblivious.py, tools/check_tree_cache_oblivious.py)
+  so the three tools cannot drift.
+- :mod:`locklint` — AST lock-discipline lint for the pipelined host path
+  (engine/batcher.py, server/scheduler.py, engine/journal.py): the PR-10
+  single-lock-hold invariant, stage-1-outside-the-lock, lock-ordering
+  acyclicity, and shared-mutable-attribute coverage.
+
+Driven by tools/check_oblivious.py across the live knob matrix, with
+:mod:`mutants` as the seeded positive controls (each must FAIL).
+"""
+
+from .jaxpr_walk import census, plane_rows, site_of, walk_eqns
+from .oblint import (
+    AllowEntry,
+    OblintReport,
+    Violation,
+    analyze,
+    census_equal,
+)
+
+__all__ = [
+    "AllowEntry",
+    "OblintReport",
+    "Violation",
+    "analyze",
+    "census",
+    "census_equal",
+    "plane_rows",
+    "site_of",
+    "walk_eqns",
+]

@@ -1,0 +1,175 @@
+"""The reviewed allowlist: every secret-indexed sink the engine is
+*allowed* to contain, each with its one-line leak argument.
+
+Contract (enforced by tools/check_oblivious.py):
+
+- any taint-flagged sink NOT listed here fails the audit — a new
+  secret-derived gather/scatter/predicate cannot land without a review
+  adding its entry and its argument;
+- any entry never *reached* in the swept knob matrix fails the audit —
+  dead entries rot into blanket permissions and are exactly how a later
+  leak hides behind an old review.
+
+The arguments fall into four standings, all rooted in the threat model
+(oram/path_oram.py): the public transcript is the HBM bucket-tree
+access sequence; the stash, position map, tree-top cache, and per-round
+working set are EPC-analog **private working memory** (ciphertext at
+rest IS public — which is why the cipher key is a taint anchor).
+
+1. *one-time uniform paths*: tree accesses indexed by leaves that are
+   consumed exactly once then remapped to fresh uniform draws — the
+   Path-ORAM invariant; the transcript is i.i.d. uniform whatever the
+   ops were.
+2. *private working memory*: accesses into stash/posmap/cache/working
+   rows; the round executes a fixed schedule of them (the census gates
+   pin this), only their *contents* vary.
+3. *oblivious permutation plumbing*: sort/rank/segmented-scan data
+   movement over fixed [B]/[W] arrays — every row moves exactly once
+   per pass; the permutation's value is secret, its shape is not.
+4. *fixed full sweeps*: iota-scheduled walks that touch every row
+   regardless of the data (the expiry sweep).
+"""
+
+from __future__ import annotations
+
+from .oblint import AllowEntry
+
+_A = AllowEntry
+
+#: one ORAM access round (oram/round.py and everything under it)
+_ORAM_CORE = (
+    _A("gather", "oram/path_oram.py:_path_gather",
+       "path fetch indexed by one-time leaves: each position is read "
+       "once then remapped, so every fetched path is an independent "
+       "uniform draw (Path-ORAM invariant)"),
+    _A("scatter", "oram/path_oram.py:_path_scatter",
+       "write-back of exactly the fetched paths, owner-masked — the "
+       "write transcript is identical to the read transcript"),
+    _A("gather", "oram/path_oram.py:working_leaves",
+       "leaf lookup in the flat position table, private working memory "
+       "(one fixed [W]-shaped gather per round)"),
+    _A("gather", "oram/round.py:oram_round",
+       "private working-set reads: block->row map, initial-value rows, "
+       "and cache-top planes — stash-standing memory on a fixed "
+       "per-round schedule"),
+    _A("scatter", "oram/round.py:oram_round",
+       "commits into private planes (working rows, eviction slots, "
+       "stash recompaction, cache-top write-back): fixed shapes, "
+       "unique in-bounds targets, owner-masked"),
+    _A("scatter", "oram/round.py:_bucket_owner_map",
+       "owner election: one scatter-min over exactly B*path_len heap "
+       "slots per round into a private dense map, whatever the leaves"),
+    _A("gather", "oram/round.py:occurrence_masks_sorted",
+       "sorted dedup: permutation/boundary gathers over fixed [B] "
+       "arrays — oblivious-sort data movement, schedule fixed by B"),
+)
+
+#: position-map resolution (flat table and recursive internal ORAM)
+_POSMAP = (
+    _A("gather", "oram/posmap.py:lookup_remap_round",
+       "flat position-map read: the table is private working memory; "
+       "exactly one [B]-gather per round"),
+    _A("scatter", "oram/posmap.py:lookup_remap_round",
+       "flat position-map remap write: same private table, one "
+       "[B]-scatter per round, OOB-dropped for non-winners"),
+    _A("gather", "oram/posmap.py:apply_pm",
+       "recursive map entry extract/merge inside the internal round's "
+       "private working set (fixed per-round schedule)"),
+    _A("scatter", "oram/posmap.py:apply_pm",
+       "recursive map entry writes onto committed internal rows — "
+       "private working set, unique in-bounds targets"),
+    _A("gather", "oram/posmap.py:_group_last_slot",
+       "sorted last-occurrence dedup: the occurrence_masks_sorted "
+       "mirror, permutation gathers over fixed [B] arrays"),
+)
+
+#: oblivious sort/scan machinery (bit-identity with argsort is
+#: separately pinned by tests/test_radix.py, test_segmented.py)
+_SORTS = (
+    _A("gather", "oblivious/primitives.py:lex_argsort",
+       "two-pass stable 64-bit argsort: take_along_axis by the first "
+       "pass's permutation — every row moves exactly once per pass"),
+    _A("gather", "oblivious/radix.py:_rank_pass",
+       "counting-sort rank pass: per-digit histogram reads, all B rows "
+       "touched exactly once per pass"),
+    _A("scatter", "oblivious/radix.py:_rank_pass",
+       "counting-sort histogram scatter: fixed digit-bucket array, all "
+       "B rows contribute exactly once per pass"),
+    _A("gather", "oblivious/radix.py:radix_group_sort",
+       "radix group sort: permutation gathers over fixed [B] arrays"),
+    _A("scatter", "oblivious/radix.py:radix_group_sort",
+       "radix group sort: rank-targeted scatter — targets are a "
+       "permutation of [B], every row written once"),
+    _A("scatter", "oblivious/radix.py:radix_rank",
+       "radix rank materialization: permutation scatter over [W]"),
+    _A("scatter", "oblivious/segmented.py:multiword_group_sort",
+       "wide-key group sort: inverse-permutation scatter over fixed "
+       "[B] arrays"),
+    _A("gather", "oblivious/segmented.py:group_sort",
+       "bounded-key group sort: permutation gathers over fixed [B]"),
+    _A("gather", "oblivious/segmented.py:segmented_sum_before",
+       "segmented scan boundary reads: permutation-indexed, fixed [B]"),
+    _A("gather", "oblivious/segmented.py:segmented_sum_total",
+       "segmented totals broadcast back by segment id: fixed [B]"),
+)
+
+#: slot-order semantics + admission (engine/vphases.py): all of it runs
+#: over per-op [B] working rows — private memory with a per-round
+#: schedule that is a constant of the geometry (the quota-admission
+#: *aggregate* branch is the one documented exception, and it selects
+#: between two always-executed programs, never skips one)
+_VPHASES = (
+    _A("gather", "engine/vphases.py:_admission_fast",
+       "quota-decoupled admission: rank/slot gathers over [B] counters "
+       "in private working memory"),
+    _A("gather", "engine/vphases.py:apply_batch",
+       "slot-order chain resolution: same-key row gathers over the "
+       "fixed [B] working set"),
+    _A("scatter", "engine/vphases.py:apply_batch",
+       "slot-order chain commits: [B]-row scatters into private "
+       "working rows, unique in-bounds targets"),
+    _A("gather", "engine/vphases.py:select_by_rank",
+       "k-th-flag selection: rank-indexed gather over fixed [B]"),
+    _A("scatter", "engine/vphases.py:select_by_rank",
+       "k-th-flag selection: rank scatter over fixed [B]"),
+    _A("gather", "engine/vphases.py:group_first",
+       "group-boundary gather over the sorted [B] slot order"),
+    _A("gather", "engine/vphases.py:group_last",
+       "group-boundary gather over the sorted [B] slot order"),
+    _A("gather", "engine/vphases.py:first_flag_index",
+       "first-flag rank gather over fixed [B]"),
+    _A("gather", "engine/vphases.py:last_flag_index",
+       "last-flag rank gather over fixed [B]"),
+    _A("gather", "engine/vphases.py:_to",
+       "scan-impl permutation into sorted order: fixed [B] gather"),
+    _A("gather", "engine/vphases.py:_back",
+       "scan-impl permutation out of sorted order: fixed [B] gather"),
+    _A("scatter", "engine/vphases.py:step",
+       "exact-admission scan body: per-op counter updates, private [B] "
+       "state, fixed trip count"),
+    _A("dynamic_slice", "engine/vphases.py:step",
+       "exact-admission scan body: the scan's own per-op row slice — "
+       "trip count and slice shape are constants of B"),
+)
+
+#: engine round glue + expiry sweep
+_ENGINE = (
+    _A("scatter", "engine/round_step.py:engine_round_step",
+       "freed-block push: rank-compaction scatter into the private "
+       "freelist — at most B unique in-bounds targets, fixed shape"),
+    _A("scatter", "engine/expiry.py:expiry_sweep",
+       "sweep bookkeeping (freelist rebuild, recipient release): "
+       "rank-compaction scatters into private tables after an "
+       "iota-scheduled full-tree walk"),
+    _A("scatter", "engine/expiry.py:rec_body",
+       "per-chunk liveness marking: presence bits scattered by private "
+       "block ids into a private [max_messages] table; every tree row "
+       "is visited on the fixed chunk schedule"),
+)
+
+#: the one reviewed list the driver sweeps (tools/check_oblivious.py)
+ENGINE_ALLOWLIST: tuple = _ORAM_CORE + _POSMAP + _SORTS + _VPHASES + _ENGINE
+
+
+def entries_by_key() -> dict:
+    return {e.key: e for e in ENGINE_ALLOWLIST}

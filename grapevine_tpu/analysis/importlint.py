@@ -1,0 +1,122 @@
+"""Stdlib fallback for the ruff tier-1 gate: unused-import lint (F401).
+
+The repo pins ruff's pyflakes/import tier in pyproject.toml
+(``[tool.ruff] select = ["E4", "E7", "E9", "F"]``) and tier-1 runs
+``ruff check`` wherever the binary exists (tests/test_lint.py). This
+container image has no ruff wheel and the build bakes its dependencies,
+so the gate needs always-on teeth that never install anything: an AST
+unused-import check — the F401 subset, plus the E9 subset for free
+(``ast.parse`` failing IS a syntax error).
+
+Deliberately conservative: a name counts as *used* if its identifier
+token appears anywhere else in the file outside the import statement's
+own line (string annotations, docstring'd doctests, ``__all__``,
+getattr strings all count). That under-reports, never false-positives —
+the right polarity for a merge gate. ``__init__.py`` re-exports are
+exempt (mirroring the pyproject per-file-ignores), as is anything with
+a ``# noqa`` on the import line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+
+def _binding_names(node) -> list:
+    """(bound_name, display) pairs for an import statement."""
+    out = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            bound = a.asname or a.name.split(".")[0]
+            out.append((bound, a.name))
+    elif isinstance(node, ast.ImportFrom):
+        if node.module == "__future__":
+            return []
+        for a in node.names:
+            if a.name == "*":
+                continue
+            out.append((a.asname or a.name, a.name))
+    return out
+
+
+def check_source(src: str, filename: str = "<src>") -> list:
+    """Unused-import findings for one file: (line, name, message)."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, "<syntax>", f"syntax error: {exc.msg}")]
+    lines = src.splitlines()
+    findings = []
+    imports = []  # (lineno, end_lineno, bound, display)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for bound, display in _binding_names(node):
+                imports.append(
+                    (node.lineno, node.end_lineno or node.lineno,
+                     bound, display)
+                )
+    for lineno, end_lineno, bound, display in imports:
+        if any(
+            "noqa" in lines[i - 1]
+            for i in range(lineno, min(end_lineno, len(lines)) + 1)
+        ):
+            continue
+        if bound == "_":
+            continue
+        pat = re.compile(rf"\b{re.escape(bound)}\b")
+        used = False
+        for i, line in enumerate(lines, start=1):
+            if lineno <= i <= end_lineno:
+                continue
+            if pat.search(line):
+                used = True
+                break
+        if not used:
+            findings.append(
+                (lineno, bound,
+                 f"F401 {display!r} imported but unused")
+            )
+    return findings
+
+
+def check_tree(root: str, skip_init: bool = True) -> dict:
+    """Lint every .py under ``root``; returns {relpath: findings}."""
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", ".claude")
+        ]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            if skip_init and fn == "__init__.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                findings = check_source(fh.read(), filename=path)
+            if findings:
+                out[os.path.relpath(path, root)] = findings
+    return out
+
+
+def main(argv=None) -> int:
+    roots = argv if argv else [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    n = 0
+    for root in roots:
+        for rel, findings in sorted(check_tree(root).items()):
+            for lineno, _name, msg in findings:
+                print(f"{os.path.join(root, rel)}:{lineno}: {msg}")
+                n += 1
+    print(f"[importlint] {n} finding(s)")
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

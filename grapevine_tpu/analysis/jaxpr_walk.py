@@ -1,0 +1,99 @@
+"""Shared jaxpr-walking core for every obliviousness audit in the repo.
+
+Before ISSUE 12 the equation walk, the primitive census, and the
+HBM-plane row accounting each lived as private copies inside
+tools/check_posmap_oblivious.py and tools/check_tree_cache_oblivious.py
+(the PR-3/5/7/8 audit lineage). They are one implementation here so the
+legacy gates and the taint analyzer (:mod:`.oblint`) see the identical
+equation stream — a sub-jaxpr a census misses is a sub-jaxpr the taint
+walk misses, and that class of drift is exactly what a unified analyzer
+exists to kill.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+#: primitives that move data between HBM arrays — the access schedule
+#: the transcript argument is about (superset of both legacy tools')
+ACCESS_PRIMS = ("gather", "scatter", "scatter-add", "scatter-mul",
+                "scatter-min", "scatter-max", "dynamic_slice",
+                "dynamic_update_slice")
+#: data-dependent control flow: forbidden anywhere in a traced round
+CONTROL_PRIMS = ("cond", "while")
+
+
+def _sub_jaxprs(eqn):
+    """Every jaxpr-valued param of ``eqn`` (pjit bodies, scan/while/cond
+    branches, custom-call wrappers), in a stable order."""
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if hasattr(x, "eqns") or hasattr(x, "jaxpr"):
+                yield x
+
+
+def walk_eqns(jaxpr):
+    """Yield every equation, recursing into every sub-jaxpr."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from walk_eqns(sub)
+
+
+def census(jaxpr) -> Counter:
+    """Primitive-name counts over a (closed) jaxpr, recursively."""
+    return Counter(eqn.primitive.name for eqn in walk_eqns(jaxpr))
+
+
+def site_of(eqn, pkg: str = "grapevine_tpu") -> str:
+    """Stable source-site key for an equation: ``file.py:function`` of
+    the innermost user frame (preferring frames inside ``pkg``).
+
+    The allowlist (:mod:`.allowlist`) is keyed on these, so the key must
+    survive line churn: function granularity, no line numbers. Returns
+    ``"<unknown>"`` when the trace carries no usable frames (e.g. a
+    jaxpr rebuilt without source info)."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    frames = list(tb.frames) if tb is not None else []
+    best = None
+    for fr in frames:
+        fn = fr.file_name.replace("\\", "/")
+        if fn.endswith("analysis/oblint.py"):
+            continue  # the analyzer's own make_jaxpr frame, never a site
+        if f"/{pkg}/" in fn or fn.startswith(f"{pkg}/"):
+            tail = fn.split(f"{pkg}/")[-1]
+            return f"{tail}:{fr.function_name}"
+        if best is None and "site-packages" not in fn and "/jax/" not in fn \
+                and not fn.endswith("/jax.py"):
+            best = f"{fn.rsplit('/', 1)[-1]}:{fr.function_name}"
+    return best or "<unknown>"
+
+
+def plane_rows(jaxpr, planes: dict) -> dict:
+    """Rows moved per named array plane by every gather/scatter in the
+    traced program.
+
+    ``planes`` maps name -> ``(shape, divisor)``: an operand whose aval
+    shape equals ``shape`` is attributed to that plane; the moved leading
+    dim is divided by ``divisor`` (flat slot planes report slots/Z). A
+    gather's row count is its output leading dim; a scatter's is its
+    updates leading dim — exactly the tree-cache tool's accounting,
+    generalized so any audit can declare its own planes."""
+    out: dict[str, list] = {k: [] for k in planes}
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if not name.startswith("scatter") and name != "gather":
+            continue
+        op_shape = tuple(eqn.invars[0].aval.shape)
+        moved = (
+            eqn.outvars[0].aval.shape
+            if name == "gather"
+            else eqn.invars[2].aval.shape
+        )
+        for pname, (pshape, div) in planes.items():
+            if op_shape == tuple(pshape):
+                rows = (moved[0] if moved else 0) // div
+                out[pname].append((name, rows))
+    return out

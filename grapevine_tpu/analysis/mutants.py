@@ -1,0 +1,158 @@
+"""Seeded leaky mutants: the analyzer's positive controls.
+
+Each mutant is a small traced program with ONE deliberate
+access-pattern leak of a distinct class. The driver
+(tools/check_oblivious.py) and tests/test_oblint.py run every mutant
+through the SAME analyzer configuration as the production sweep —
+production allowlist included — and require every one to FAIL. A mutant
+that passes means the analyzer lost its teeth (or an allowlist entry
+grew into a blanket permission), and the audit run itself errors out.
+
+The six classes, per ISSUE 12: position-dependent branch, key-indexed
+gather, data-dependent early exit, secret-shaped output, un-allowlisted
+scatter, leaky debug print. A seventh (python-level branch) pins the
+trace-abort path.
+"""
+
+from __future__ import annotations
+
+from .oblint import analyze
+
+#: every mutant: name -> (builder returning (fn, args, secrets),
+#: expected violation kind)
+_REGISTRY: dict = {}
+
+
+def _mutant(name: str, kind: str):
+    def deco(builder):
+        _REGISTRY[name] = (builder, kind)
+        return builder
+    return deco
+
+
+def _sds(*shape, dtype=None):
+    import jax
+    import numpy as np
+
+    return jax.ShapeDtypeStruct(shape, dtype or np.uint32)
+
+
+@_mutant("position_branch", "cond-predicate")
+def _position_branch():
+    """lax.cond on an ORAM position: the executed branch (and its
+    device-time signature) reveals where the block lives."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(pos, table):
+        return lax.cond(
+            pos[0] > 7,
+            lambda: jnp.sum(table),
+            lambda: jnp.zeros((), table.dtype),
+        )
+
+    return fn, {"pos": _sds(4), "table": _sds(16)}, ("pos",)
+
+
+@_mutant("key_indexed_gather", "gather-index")
+def _key_indexed_gather():
+    """A table read addressed by the recipient key — the classic
+    access-pattern leak the whole ORAM exists to prevent."""
+    def fn(key, table):
+        return table[key % 16]  # vector index -> gather
+
+    return fn, {"key": _sds(8), "table": _sds(16)}, ("key",)
+
+
+@_mutant("data_dependent_early_exit", "while-predicate")
+def _data_dependent_early_exit():
+    """A while loop whose trip count depends on the secret: wall-clock
+    (and transcript length) becomes a function of the data."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(secret):
+        def cond(c):
+            i, acc = c
+            return i < secret[0]
+
+        def body(c):
+            i, acc = c
+            return i + jnp.uint32(1), acc + i
+
+        return lax.while_loop(
+            cond, body, (jnp.uint32(0), jnp.uint32(0))
+        )
+
+    return fn, {"secret": _sds(4)}, ("secret",)
+
+
+@_mutant("secret_shaped_output", "trace-dependence")
+def _secret_shaped_output():
+    """An output whose SHAPE is the secret (a result list sized by how
+    many records matched). Cannot even trace — the analyzer converts
+    the concretization abort into the finding."""
+    import jax.numpy as jnp
+
+    def fn(secret):
+        n = int(secret[0])  # concretizes a traced value
+        return jnp.zeros((n,), jnp.uint32)
+
+    return fn, {"secret": _sds(4)}, ("secret",)
+
+
+@_mutant("unallowlisted_scatter", "scatter-index")
+def _unallowlisted_scatter():
+    """A scatter targeted by a secret-derived index at a site no review
+    ever admitted — the 'new private state without a proof' case the
+    ROADMAP items 1-2 will create pressure for."""
+    import jax.numpy as jnp
+
+    def fn(secret, plane):
+        return plane.at[secret[0] % 16].set(jnp.uint32(1))
+
+    return fn, {"secret": _sds(4), "plane": _sds(16)}, ("secret",)
+
+
+@_mutant("leaky_debug_print", "callback")
+def _leaky_debug_print():
+    """jax.debug.print of a secret: the host callback is an access
+    pattern too — it reaches the operator's terminal and logs."""
+    import jax
+
+    def fn(secret, x):
+        jax.debug.print("selected leaf {s}", s=secret[0])
+        return x + 1
+
+    return fn, {"secret": _sds(4), "x": _sds(8)}, ("secret",)
+
+
+@_mutant("python_level_branch", "trace-dependence")
+def _python_level_branch():
+    """A host-Python `if` on a traced secret — different Python paths
+    trace different programs; jax aborts, the analyzer reports."""
+    import jax.numpy as jnp
+
+    def fn(secret):
+        if secret[0] > 3:  # TracerBoolConversionError
+            return jnp.zeros((2,), jnp.uint32)
+        return jnp.ones((2,), jnp.uint32)
+
+    return fn, {"secret": _sds(4)}, ("secret",)
+
+
+def mutant_names() -> tuple:
+    return tuple(_REGISTRY)
+
+
+def run_mutants(allowlist=()) -> dict:
+    """Analyze every mutant under ``allowlist``; returns
+    name -> (report, expected_kind, failed_as_expected)."""
+    out = {}
+    for name, (builder, kind) in _REGISTRY.items():
+        fn, args, secrets = builder()
+        rep = analyze(fn, args, secrets, allowlist=allowlist,
+                      name=f"mutant/{name}")
+        hit = any(v.kind == kind for v in rep.violations)
+        out[name] = (rep, kind, hit)
+    return out

@@ -720,8 +720,10 @@ class GrapevineEngine:
         bs = self.ecfg.batch_size
         if len(reqs) > bs:
             raise ValueError("single batch only")
+        # stage-1 pack stays outside the lock, same staging as the
+        # async path (analysis/locklint.py flags pack-under-lock)
+        batch = pack_batch(reqs, bs, now)
         with self._lock:
-            batch = pack_batch(reqs, bs, now)
             if self.durability is not None:  # same contract as the async path
                 self.durability.append_round(batch, len(reqs))
             self.state, resp, transcript = self._step(self.ecfg, self.state, batch)

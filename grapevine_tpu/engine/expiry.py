@@ -43,6 +43,21 @@ from .state import (
 
 U32 = jnp.uint32
 
+#: oblint taint anchors (analysis/oblint.py): the secret inputs of one
+#: ``expiry_sweep(ecfg, state, now, period, now_hi)`` — THE SAME
+#: private-plane/key/freelist anchors as the engine round, imported
+#: from round_step so a new private plane cannot be tainted in one
+#: audit and forgotten in the other; the sweep's chunk walk itself is
+#: iota-driven and must stay untainted. ``now``/``period`` are the
+#: untrusted host clock: public.
+from .round_step import _tree_secrets as _rs_tree_secrets  # noqa: E402
+
+OBLINT_SECRETS = (
+    _rs_tree_secrets("state.rec")
+    + _rs_tree_secrets("state.mb")
+    + ("state.freelist", "state.hash_key", "state.id_key", "state.rng")
+)
+
 
 def _expired(ts_lo, ts_hi, now_lo, now_hi, period) -> jnp.ndarray:
     """Strict '>' age test over u64 lane pairs (now - ts > period).

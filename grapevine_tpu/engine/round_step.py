@@ -57,6 +57,41 @@ from .vphases import phase_a_batch, phase_b_batch, phase_c_batch
 U32 = jnp.uint32
 
 
+def _tree_secrets(prefix: str) -> tuple:
+    """The private planes of one OramState under ``prefix``: positions
+    (posmap — recursively, the whole pytree under a recursive map),
+    stash and cache contents, and the at-rest cipher key (key-taint is
+    what marks decrypted tree rows secret; ciphertext stays public)."""
+    return tuple(
+        f"{prefix}.{p}"
+        for p in (
+            "posmap", "stash_idx", "stash_val", "stash_leaf",
+            "cache_idx", "cache_val", "cache_leaf", "cipher_key",
+        )
+    )
+
+
+#: oblint taint anchors (analysis/oblint.py): the secret inputs of one
+#: full engine round ``engine_round_step(ecfg, state, batch)`` — every
+#: per-op column of the batch (who, which message, what type, what
+#: payload), both trees' private planes, and the engine's key material
+#: (hash/PRP keys mix secrets; the rng's draws become future positions).
+#: The freelist is secret too: its *contents* are freed block ids in
+#: deletion order (private EPC-analog state per the threat model in
+#: engine/state.py), even though its *height* (free_top) is the public
+#: aggregate the quota-admission standing branches on (vphases.py).
+#: Deliberately NOT secret: free_top/recipients/seq (aggregate
+#: saturation counters), nonces/epoch (public write-epoch counters),
+#: and the HBM tree ciphertext planes.
+OBLINT_SECRETS = (
+    ("batch.req_type", "batch.auth", "batch.msg_id", "batch.recipient",
+     "batch.payload", "state.freelist", "state.hash_key",
+     "state.id_key", "state.rng")
+    + _tree_secrets("state.rec")
+    + _tree_secrets("state.mb")
+)
+
+
 def transcript_key_groups(batch: dict, mb_choices: int):
     """Host-side mirror of this step's key selection, for the leak
     monitor (obs/leakmon.py).

@@ -278,6 +278,18 @@ def _group_last_slot(idxs, dummy_index, occ_impl, sort_impl, key_bits):
     return jnp.where(is_real, last, slot_iota)
 
 
+#: oblint taint anchors (analysis/oblint.py): the secret inputs of one
+#: ``lookup_remap_round`` — the queried indices, every position the map
+#: holds (flat table contents, or the whole recursive pytree: internal
+#: tree plaintext via its cipher key, internal stash/posmap), the fresh
+#: remap/dummy leaves (future fetch paths), and the occurrence masks
+#: (functions of the secret indices).
+OBLINT_SECRETS = (
+    "idxs", "pm_state", "new_leaves", "dummy_leaves",
+    "first_occ", "last_occ", "pm_new_leaves", "pm_dummy_leaves",
+)
+
+
 def lookup_remap_round(
     cfg,
     pm_state,

@@ -69,6 +69,23 @@ from .path_oram import (
 
 U32 = jnp.uint32
 
+#: oblint taint anchors (analysis/oblint.py): the secret inputs of one
+#: ``oram_round(cfg, state, idxs, new_leaves, dummy_leaves, ...)`` —
+#: block indices, every current/future position (posmap contents and the
+#: fresh remap/dummy leaves are all future fetch paths), the private
+#: stash/cache planes, and the at-rest cipher key (tainting the key is
+#: what marks every *decrypted* tree row secret: plaintext is
+#: key-derived, ciphertext is public). Argument-name/dotted-path
+#: prefixes over the function's signature; tools/check_oblivious.py
+#: resolves them against the flattened trace.
+OBLINT_SECRETS = (
+    "idxs", "new_leaves", "dummy_leaves",
+    "pm_new_leaves", "pm_dummy_leaves",
+    "state.posmap", "state.stash_idx", "state.stash_val",
+    "state.stash_leaf", "state.cache_idx", "state.cache_val",
+    "state.cache_leaf", "state.cipher_key",
+)
+
 
 def occurrence_masks(idxs: jax.Array, dummy_index: int):
     """(first_occ, last_occ, chain_slot) over real (non-dummy) indices.

@@ -10,7 +10,6 @@ half-loaded. The process-kill half lives in tests/test_chaos_recovery.py
 
 import importlib.util
 import os
-import struct
 
 import numpy as np
 import pytest

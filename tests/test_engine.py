@@ -7,7 +7,6 @@ Test pyramid items (2), (4) from SURVEY.md §4.
 import random
 
 import numpy as np
-import pytest
 
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import GrapevineEngine

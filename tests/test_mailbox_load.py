@@ -15,7 +15,6 @@ the op-major oracle engine's scheme) covered.
 
 import random
 
-import numpy as np
 
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import GrapevineEngine

@@ -1,6 +1,5 @@
 """Batch-level metrics (engine/metrics.py): counters, occupancy, p99."""
 
-import numpy as np
 
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.engine.batcher import GrapevineEngine

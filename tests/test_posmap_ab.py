@@ -28,7 +28,6 @@ import os
 import random
 import sys
 
-import jax
 import numpy as np
 import pytest
 

@@ -29,41 +29,27 @@ all-same, all-dummy, anything). The jaxpr-audit pattern of PR 3/PR 5
 
 Wired into tier-1 next to check_telemetry_policy / check_perf_regression
 via tests/test_posmap.py; standalone: ``python tools/check_posmap_oblivious.py``.
+
+Since ISSUE 12 this is a thin wrapper over the shared analyzer core
+(grapevine_tpu/analysis/jaxpr_walk.py) — the census here, the tree-cache
+tool's, and the taint analyzer's all walk the identical equation stream,
+so the three gates cannot drift. CLI and exit codes are unchanged.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-from collections import Counter
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-#: primitives that move data between HBM arrays — the access schedule
-#: the transcript argument is about
-_ACCESS_PRIMS = ("gather", "scatter", "scatter-add", "dynamic_slice",
-                 "dynamic_update_slice")
-#: data-dependent control flow: forbidden anywhere in the lookup
-_CONTROL_PRIMS = ("cond", "while")
-
-
-def _census(jaxpr, out=None) -> Counter:
-    """Primitive-name counts over a (closed) jaxpr, recursing into every
-    sub-jaxpr (pjit bodies, scans, custom calls)."""
-    out = Counter() if out is None else out
-    inner = getattr(jaxpr, "jaxpr", jaxpr)
-    for eqn in inner.eqns:
-        out[eqn.primitive.name] += 1
-        for v in eqn.params.values():
-            if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
-                _census(v, out)
-            elif isinstance(v, (tuple, list)):
-                for x in v:
-                    if hasattr(x, "eqns") or hasattr(x, "jaxpr"):
-                        _census(x, out)
-    return out
+from grapevine_tpu.analysis.jaxpr_walk import (  # noqa: E402
+    ACCESS_PRIMS as _ACCESS_PRIMS,
+    CONTROL_PRIMS as _CONTROL_PRIMS,
+    census as _census,
+)
 
 
 def _index_sets(cfg, b: int):

@@ -27,37 +27,31 @@ facts, not runtime sampling):
 
 Wired into tier-1 via tests/test_tree_cache.py; standalone:
 ``python tools/check_tree_cache_oblivious.py``.
+
+Since ISSUE 12 the equation walk / census / plane row accounting live in
+the shared analyzer core (grapevine_tpu/analysis/jaxpr_walk.py) — this
+tool, the posmap gate, and the taint analyzer cannot drift. CLI and
+exit codes are unchanged. ISSUE 12 also closed a matrix gap: the
+``k=0, posmap_impl=recursive`` cell now has its own always-on census
+(:func:`check_k0_recursive_census`) instead of riding only the heavy
+``-m slow`` recursive audit.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-from collections import Counter
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-_ACCESS_PRIMS = ("gather", "scatter", "scatter-add", "scatter-min",
-                 "dynamic_slice", "dynamic_update_slice")
-_CONTROL_PRIMS = ("cond", "while")
-
-
-def _walk(jaxpr):
-    """Yield every equation, recursing into sub-jaxprs."""
-    inner = getattr(jaxpr, "jaxpr", jaxpr)
-    for eqn in inner.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (tuple, list)) else (v,)
-            for x in vs:
-                if hasattr(x, "eqns") or hasattr(x, "jaxpr"):
-                    yield from _walk(x)
-
-
-def _census(jaxpr) -> Counter:
-    return Counter(eqn.primitive.name for eqn in _walk(jaxpr))
+from grapevine_tpu.analysis.jaxpr_walk import (  # noqa: E402
+    ACCESS_PRIMS as _ACCESS_PRIMS,  # noqa: F401 - part of the gate's API
+    CONTROL_PRIMS as _CONTROL_PRIMS,
+    census as _census,
+    plane_rows as _shared_plane_rows,
+)
 
 
 def _index_sets(cfg, b: int):
@@ -100,11 +94,9 @@ def _trace_round(cfg, idxs, b: int):
     return jax.make_jaxpr(run)(state, lf, lf, lf, lf)
 
 
-def _plane_rows(jaxpr, cfg) -> dict:
-    """Rows moved per HBM tree plane (and cache plane) by every
-    gather/scatter in the traced round, keyed by plane name. A gather's
-    row count is its output leading dim; a scatter's is its updates
-    leading dim; flat slot planes report slots/Z."""
+def _tree_planes(cfg) -> dict:
+    """This geometry's HBM tree planes (and cache planes at k>0) in the
+    shared ``plane_rows`` declaration format: name -> (shape, divisor)."""
     z, v = cfg.bucket_slots, cfg.value_words
     n = cfg.n_buckets_padded
     cb = cfg.cache_buckets
@@ -115,30 +107,18 @@ def _plane_rows(jaxpr, cfg) -> dict:
     }
     if cfg.posmap is not None:
         planes["tree_leaf"] = ((n * z,), z)
-    cplanes = {}
     if cb:
-        cplanes = {
-            "cache_idx": ((cb * z,), z),
-            "cache_val": ((cb, z * v), 1),
-        }
+        planes["cache_idx"] = ((cb * z,), z)
+        planes["cache_val"] = ((cb, z * v), 1)
         if cfg.posmap is not None:
-            cplanes["cache_leaf"] = ((cb * z,), z)
-    out: dict[str, list] = {k: [] for k in {**planes, **cplanes}}
-    for eqn in _walk(jaxpr):
-        name = eqn.primitive.name
-        if not name.startswith("scatter") and name != "gather":
-            continue
-        op_shape = tuple(eqn.invars[0].aval.shape)
-        moved = (
-            eqn.outvars[0].aval.shape
-            if name == "gather"
-            else eqn.invars[2].aval.shape
-        )
-        for pname, (pshape, div) in {**planes, **cplanes}.items():
-            if op_shape == pshape:
-                rows = (moved[0] if moved else 0) // div
-                out[pname].append((name, rows))
-    return out
+            planes["cache_leaf"] = ((cb * z,), z)
+    return planes
+
+
+def _plane_rows(jaxpr, cfg) -> dict:
+    """Rows moved per HBM tree plane (and cache plane): the shared
+    analyzer core's accounting over this geometry's plane declarations."""
+    return _shared_plane_rows(jaxpr, _tree_planes(cfg))
 
 
 def check_tree_cache_schedule(
@@ -226,6 +206,56 @@ def check_tree_cache_schedule(
     return out
 
 
+def check_k0_recursive_census(b: int = 4, height: int = 4) -> dict:
+    """The matrix cell the pre-ISSUE-12 wiring missed: ``k=0`` with
+    ``posmap_impl=recursive``.
+
+    Tier-1 ran the full two-claim audit flat-only (the recursive variant
+    rode ``-m slow``), so the uncached-recursive round — the exact
+    program a `--posmap-impl recursive --tree-top-cache-levels 0` server
+    runs — had no always-on index-blindness census. This runs claim 1
+    (identical census across adversarial index sets, zero data-dependent
+    control flow) plus the tree_leaf-plane row accounting for that one
+    cell at a deliberately small geometry; returns the per-plane rows."""
+    from grapevine_tpu.oram.path_oram import OramConfig
+    from grapevine_tpu.oram.posmap import derive_posmap_spec
+
+    cfg = OramConfig(
+        height=height, value_words=8, n_blocks=1 << height,
+        cipher_rounds=8, top_cache_levels=0,
+        posmap=derive_posmap_spec(1 << height, top_cache_levels=0),
+    )
+    censuses = {
+        iname: _census(_trace_round(cfg, idxs, b))
+        for iname, idxs in _index_sets(cfg, b).items()
+    }
+    base_name, base = next(iter(censuses.items()))
+    for iname, c in censuses.items():
+        assert c == base, (
+            f"k=0 recursive round traces a DIFFERENT program for index "
+            f"set {iname!r} vs {base_name!r}: {(c - base) + (base - c)}"
+        )
+    n_control = sum(base[p] for p in _CONTROL_PRIMS)
+    assert n_control == 0, (
+        f"k=0 recursive: data-dependent control flow "
+        f"({ {p: base[p] for p in _CONTROL_PRIMS if base[p]} })"
+    )
+    rows = _plane_rows(
+        _trace_round(cfg, _index_sets(cfg, b)["mixed_dups"], b), cfg
+    )
+    want = b * cfg.path_len  # k=0: the full path on every plane
+    for pname in ("tree_idx", "tree_val", "nonces", "tree_leaf"):
+        moved = rows[pname]
+        assert moved, f"k=0 recursive: no accesses seen on {pname}"
+        bad = [r for _, r in moved if r != want]
+        assert not bad, (
+            f"k=0 recursive: {pname} moves {sorted(set(bad))} rows — "
+            f"want the full B*path_len = {want}"
+        )
+    assert "cache_idx" not in rows, "k=0 must declare no cache planes"
+    return {p: sorted({r for _, r in rs}) for p, rs in rows.items() if rs}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -239,6 +269,8 @@ def main(argv=None) -> int:
             recursive=recursive,
         )
         print(f"[check_tree_cache_oblivious] recursive={recursive}: OK {out}")
+    out = check_k0_recursive_census(b=4, height=4)
+    print(f"[check_tree_cache_oblivious] k0-recursive cell: OK {out}")
     print("[check_tree_cache_oblivious] PASS: cached round is index-blind "
           "and HBM path traffic is exactly B·(path_len−k) rows per plane")
     return 0
