@@ -1,6 +1,6 @@
-"""Static obliviousness + concurrency analysis (ISSUE 12).
+"""Static obliviousness + overflow + concurrency analysis (ISSUEs 12/14).
 
-Two prongs, one package:
+Three prongs, one package:
 
 - :mod:`oblint` — jaxpr-level taint-propagation analyzer proving that no
   gather/scatter index, cond/while predicate, dynamic-slice start, or
@@ -10,13 +10,20 @@ Two prongs, one package:
   (:mod:`jaxpr_walk`) back both this analyzer and the legacy CI gates
   (tools/check_posmap_oblivious.py, tools/check_tree_cache_oblivious.py)
   so the three tools cannot drift.
+- :mod:`rangelint` — interval-domain abstract interpreter over the same
+  equation walk, certifying the round's u32/int32 lanes wraparound-,
+  truncation-, and clamped-OOB-free at the declared geometry
+  (RANGELINT_BOUNDS anchors; the mod-2^32-by-design sites ride
+  ``allowlist.RANGE_ALLOWLIST``). Driven by tools/check_ranges.py up to
+  the certified bound and the 2^36 design-point refusal.
 - :mod:`locklint` — AST lock-discipline lint for the pipelined host path
   (engine/batcher.py, server/scheduler.py, engine/journal.py): the PR-10
   single-lock-hold invariant, stage-1-outside-the-lock, lock-ordering
   acyclicity, and shared-mutable-attribute coverage.
 
-Driven by tools/check_oblivious.py across the live knob matrix, with
-:mod:`mutants` as the seeded positive controls (each must FAIL).
+Driven by tools/check_oblivious.py + tools/check_ranges.py across the
+live knob matrix, with :mod:`mutants` as the seeded positive controls
+for BOTH analyzers (each must FAIL).
 """
 
 from .jaxpr_walk import census, plane_rows, site_of, walk_eqns
@@ -27,12 +34,16 @@ from .oblint import (
     analyze,
     census_equal,
 )
+from .rangelint import RangeFinding, RangeReport, analyze_ranges
 
 __all__ = [
     "AllowEntry",
     "OblintReport",
+    "RangeFinding",
+    "RangeReport",
     "Violation",
     "analyze",
+    "analyze_ranges",
     "census",
     "census_equal",
     "plane_rows",

@@ -173,3 +173,75 @@ ENGINE_ALLOWLIST: tuple = _ORAM_CORE + _POSMAP + _SORTS + _VPHASES + _ENGINE
 
 def entries_by_key() -> dict:
     return {e.key: e for e in ENGINE_ALLOWLIST}
+
+
+#: ----------------------------------------------------------------------
+#: Rangelint's reviewed allowlist (analysis/rangelint.py; swept by
+#: tools/check_ranges.py with the same dead-entry rule as the taint
+#: list): every *intentionally* mod-2^32 operation in the compiled
+#: round, each with its one-line range argument. The shape of every
+#: argument is the same: the wrap is the operation's DEFINITION (a
+#: cipher/mixer round, a two-lane carry), not an accident of geometry —
+#: the pair/primitive downstream restores or never needed the
+#: mathematical value. Anything wrapping outside these sites fails the
+#: audit.
+RANGE_ALLOWLIST: tuple = (
+    # ChaCha (oblivious/bucket_cipher.py): ARX is arithmetic mod 2^32
+    # by RFC 7539 — the keystream is DEFINED over the wrapped lanes
+    _A("add", "oblivious/bucket_cipher.py:_qr",
+       "ChaCha quarter-round addition is mod-2^32 by cipher definition"),
+    _A("shift_left", "oblivious/bucket_cipher.py:_rotl",
+       "rotate-left: the bits shifted past 32 re-enter via the OR'd "
+       "logical right shift — no information leaves the lane"),
+    _A("add", "oblivious/bucket_cipher.py:chacha_blocks",
+       "the state+init feedforward of the ChaCha block function, "
+       "mod-2^32 by RFC 7539"),
+    _A("add", "oblivious/bucket_cipher.py:epoch_next",
+       "u64 write-epoch as (lo, hi) u32 lanes: the lo lane wraps by "
+       "design and the explicit carry feeds hi — the PAIR is the "
+       "counter, 64-bit and unwrappable in any feasible lifetime"),
+    # u64 two-lane helpers (oblivious/primitives.py)
+    _A("add", "oblivious/primitives.py:u64_add_u32",
+       "u64 carry arithmetic in u32 lanes: lo wraps mod 2^32, the "
+       "comparison-derived carry moves the overflow into hi"),
+    _A("sub", "oblivious/primitives.py:u64_sub",
+       "u64 borrow arithmetic in u32 lanes: lo wraps mod 2^32, the "
+       "comparison-derived borrow moves the underflow into hi"),
+    # keyed mixers: mb_bucket_hash (engine/state.py) and the Feistel
+    # PRP round function (oblivious/prp.py) — murmur-style ARX whose
+    # output is masked to the table/domain width at the call site
+    _A("mul", "engine/state.py:mb_bucket_hash",
+       "keyed bucket-hash mixing multiplies are mod-2^32 by design; "
+       "the result is masked to the (power-of-two) table width"),
+    _A("add", "engine/state.py:mb_bucket_hash",
+       "keyed bucket-hash mixing adds are mod-2^32 by design; the "
+       "result is masked to the (power-of-two) table width"),
+    _A("shift_left", "engine/state.py:mb_bucket_hash",
+       "bucket-hash rotates: dropped high bits re-enter via the OR'd "
+       "right shift"),
+    _A("mul", "oblivious/prp.py:_f",
+       "Feistel round-function multiplies are mod-2^32 by design; the "
+       "half is masked to its domain width after each round"),
+    _A("shift_left", "oblivious/prp.py:_f",
+       "Feistel round-function rotate: dropped high bits re-enter via "
+       "the OR'd right shift"),
+    # invariant-backed sites: the wrap/blowup is impossible by a
+    # reviewed program invariant an oracle-equality suite pins, which
+    # a non-relational interval domain cannot express
+    _A("sub", "engine/round_step.py:engine_round_step",
+       "free_top - n_allocs: phase-A admission never allocates more "
+       "blocks than the freelist holds (quota invariant, oracle-"
+       "pinned); the adjacent min re-bounds the result for downstream"),
+    _A("reduce_sum", "engine/vphases.py:apply_batch",
+       "masked one-hot row selects (recipient-key slot match, at most "
+       "one key matches per bucket — mailbox uniqueness invariant): "
+       "the sum IS the selected row, never an accumulation"),
+    _A("reduce_sum", "engine/vphases.py:select_by_rank",
+       "rank-equality one-hot select: at most one lane of a group has "
+       "rank q, so the masked sum is a private row select"),
+    _A("add", "oblivious/radix.py:_rank_pass",
+       "counting-rank recombination: zeros-rank + ones-rank of one "
+       "stable partition is a permutation of [0, B) (sums below B "
+       "pointwise, 2B only in interval arithmetic); the adjacent clip "
+       "re-bounds the lane for downstream"),
+)

@@ -1,20 +1,30 @@
-"""Stdlib fallback for the ruff tier-1 gate: unused-import lint (F401).
+"""Stdlib fallback for the ruff tier-1 gate: F401 + F841 + E722 (+ E9).
 
 The repo pins ruff's pyflakes/import tier in pyproject.toml
 (``[tool.ruff] select = ["E4", "E7", "E9", "F"]``) and tier-1 runs
 ``ruff check`` wherever the binary exists (tests/test_lint.py). This
 container image has no ruff wheel and the build bakes its dependencies,
-so the gate needs always-on teeth that never install anything: an AST
-unused-import check — the F401 subset, plus the E9 subset for free
-(``ast.parse`` failing IS a syntax error).
+so the gate needs always-on teeth that never install anything — an AST
+checker for the subsets that matter and never false-positive:
 
-Deliberately conservative: a name counts as *used* if its identifier
-token appears anywhere else in the file outside the import statement's
-own line (string annotations, docstring'd doctests, ``__all__``,
-getattr strings all count). That under-reports, never false-positives —
-the right polarity for a merge gate. ``__init__.py`` re-exports are
-exempt (mirroring the pyproject per-file-ignores), as is anything with
-a ``# noqa`` on the import line.
+- **F401** unused import (the original check);
+- **F841** unused local variable — simple ``name = value`` bindings
+  (and ``except ... as name`` handlers) whose name is never read
+  anywhere in the enclosing function, skipping underscore names,
+  augmented/annotated/tuple targets, declared globals/nonlocals, and
+  any function that touches ``locals()``/``eval``/``exec``;
+- **E722** bare ``except:`` — swallows ``KeyboardInterrupt`` and
+  ``SystemExit``; name the exception (``except Exception:`` at
+  minimum);
+- **E9** for free (``ast.parse`` failing IS a syntax error).
+
+Deliberately conservative throughout: for F401 a name counts as *used*
+if its identifier token appears anywhere else in the file outside the
+import statement's own line (string annotations, docstring'd doctests,
+``__all__``, getattr strings all count). That under-reports, never
+false-positives — the right polarity for a merge gate. ``__init__.py``
+re-exports are exempt (mirroring the pyproject per-file-ignores), as is
+any line carrying ``# noqa``.
 """
 
 from __future__ import annotations
@@ -41,14 +51,89 @@ def _binding_names(node) -> list:
     return out
 
 
+def _own_scope_stores(fn_node) -> list:
+    """Simple-name Assign targets and ``except as`` names in THIS
+    function's scope only — nested function/class scopes bind their own
+    locals and are skipped."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate scope
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                out.append((child.lineno, child.targets[0].id))
+            elif isinstance(child, ast.ExceptHandler) and child.name:
+                out.append((child.lineno, child.name))
+            visit(child)
+
+    visit(fn_node)
+    return out
+
+
+def _function_f841(fn_node, noqa_lines: set) -> list:
+    """F841 findings for one function node (conservative, see module
+    docstring): stores from this scope, loads from the whole subtree
+    (closures in nested defs legitimately read enclosing locals)."""
+    dynamic = False
+    declared: set = set()
+    loads: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ("locals", "eval",
+                                                    "exec", "vars"):
+                dynamic = True
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared.update(node.names)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            # x += 1 reads AND writes x: the prior binding is required
+            # (deleting it raises UnboundLocalError), so it counts as a
+            # use — the never-false-positive polarity
+            loads.add(node.target.id)
+        elif isinstance(node, ast.Name) and not isinstance(
+                node.ctx, ast.Store):
+            loads.add(node.id)  # Load and Del both count as uses
+    if dynamic:
+        return []
+    out = []
+    seen: set = set()
+    for lineno, name in _own_scope_stores(fn_node):
+        if (name in loads or name in declared or name.startswith("_")
+                or name in seen or lineno in noqa_lines):
+            continue
+        seen.add(name)
+        out.append(
+            (lineno, name,
+             f"F841 local variable {name!r} is assigned to but never used")
+        )
+    return out
+
+
 def check_source(src: str, filename: str = "<src>") -> list:
-    """Unused-import findings for one file: (line, name, message)."""
+    """F401/F841/E722 findings for one file: (line, name, message)."""
     try:
         tree = ast.parse(src, filename=filename)
     except SyntaxError as exc:
         return [(exc.lineno or 0, "<syntax>", f"syntax error: {exc.msg}")]
     lines = src.splitlines()
+    noqa_lines = {
+        i for i, line in enumerate(lines, start=1) if "noqa" in line
+    }
     findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and node.lineno not in noqa_lines:
+            findings.append(
+                (node.lineno, "<bare-except>",
+                 "E722 bare 'except:' swallows KeyboardInterrupt/"
+                 "SystemExit — name the exception class")
+            )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_function_f841(node, noqa_lines))
     imports = []  # (lineno, end_lineno, bound, display)
     for node in ast.walk(tree):
         if isinstance(node, (ast.Import, ast.ImportFrom)):

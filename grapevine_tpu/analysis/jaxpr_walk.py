@@ -60,8 +60,8 @@ def site_of(eqn, pkg: str = "grapevine_tpu") -> str:
     best = None
     for fr in frames:
         fn = fr.file_name.replace("\\", "/")
-        if fn.endswith("analysis/oblint.py"):
-            continue  # the analyzer's own make_jaxpr frame, never a site
+        if fn.endswith("analysis/oblint.py") or fn.endswith("analysis/rangelint.py"):
+            continue  # an analyzer's own make_jaxpr frame, never a site
         if f"/{pkg}/" in fn or fn.startswith(f"{pkg}/"):
             tail = fn.split(f"{pkg}/")[-1]
             return f"{tail}:{fr.function_name}"

@@ -394,7 +394,6 @@ def lint_sources(sources: dict, allow: tuple = LOCK_ALLOW) -> list:
     for fname, src in sources.items():
         classes.update(_extract(ast.parse(src, filename=fname)))
     out: list = []
-    allowed = {(a.cls, a.attr) for a in allow}
 
     # 1. PR-10 single-hold --------------------------------------------------
     eng = classes.get("GrapevineEngine")

@@ -1,22 +1,28 @@
-"""Seeded leaky mutants: the analyzer's positive controls.
+"""Seeded mutants: both analyzers' positive controls.
 
-Each mutant is a small traced program with ONE deliberate
-access-pattern leak of a distinct class. The driver
-(tools/check_oblivious.py) and tests/test_oblint.py run every mutant
-through the SAME analyzer configuration as the production sweep —
-production allowlist included — and require every one to FAIL. A mutant
-that passes means the analyzer lost its teeth (or an allowlist entry
-grew into a blanket permission), and the audit run itself errors out.
+Each mutant is a small traced program with ONE deliberate defect of a
+distinct class. The drivers (tools/check_oblivious.py,
+tools/check_ranges.py) and the test suites run every mutant through the
+SAME analyzer configuration as the production sweep — production
+allowlists included — and require every one to FAIL. A mutant that
+passes means an analyzer lost its teeth (or an allowlist entry grew
+into a blanket permission), and the audit run itself errors out.
 
-The six classes, per ISSUE 12: position-dependent branch, key-indexed
-gather, data-dependent early exit, secret-shaped output, un-allowlisted
-scatter, leaky debug print. A seventh (python-level branch) pins the
-trace-abort path.
+Obliviousness classes, per ISSUE 12: position-dependent branch,
+key-indexed gather, data-dependent early exit, secret-shaped output,
+un-allowlisted scatter, leaky debug print, python-level branch.
+
+Overflow classes, per ISSUE 14 (``_RANGE_REGISTRY``, run through
+analysis/rangelint.py): u32 leaf-arithmetic wrap, truncating cast,
+off-by-one axis bound, unbounded scan counter, int32 byte-size
+product. One shared runner (check_oblivious's mutant control) proves
+both analyzers alive from a single tier-1 gate.
 """
 
 from __future__ import annotations
 
 from .oblint import analyze
+from .rangelint import analyze_ranges
 
 #: every mutant: name -> (builder returning (fn, args, secrets),
 #: expected violation kind)
@@ -141,8 +147,135 @@ def _python_level_branch():
     return fn, {"secret": _sds(4)}, ("secret",)
 
 
+# ----------------------------------------------------------------------
+# overflow mutants (ISSUE 14): each one deliberate lane escape of a
+# distinct class, analyzed by rangelint under the PRODUCTION range
+# allowlist — none of whose mod-2^32 arguments may cover these sites
+# ----------------------------------------------------------------------
+
+#: name -> (builder returning (fn, args, bounds), expected finding kind)
+_RANGE_REGISTRY: dict = {}
+
+
+def _range_mutant(name: str, kind: str):
+    def deco(builder):
+        _RANGE_REGISTRY[name] = (builder, kind)
+        return builder
+    return deco
+
+
+@_range_mutant("u32_leaf_arith_wrap", "overflow")
+def _u32_leaf_arith_wrap():
+    """Heap-bucket-id arithmetic one recursion level past the certified
+    geometry: (2^31 - 1) + 4·leaf at 2^30 leaves silently wraps the u32
+    lane — the exact class the 2^36 design point walks into."""
+    import jax.numpy as jnp
+
+    U32 = jnp.uint32
+
+    def fn(leaf):
+        return (U32(1) << U32(31)) - U32(1) + leaf * U32(4)
+
+    return fn, {"leaf": _sds(8)}, {"leaf": (0, (1 << 30) - 1)}
+
+
+@_range_mutant("truncating_cast", "trunc-cast")
+def _truncating_cast():
+    """An unbounded u32 value narrowed to the int32 index lane: every
+    value >= 2^31 goes negative on the way into whatever it indexes."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        return x.astype(jnp.int32)
+
+    return fn, {"x": _sds(8)}, {}
+
+
+@_range_mutant("off_by_one_axis_bound", "oob-index")
+def _off_by_one_axis_bound():
+    """A gather whose declared index bound equals the axis extent — the
+    classic <= vs < slip. XLA clamps the overrun onto the last row, so
+    the program 'works' while reading the wrong data."""
+    def fn(idx, table):
+        return table[idx]
+
+    return fn, {"idx": _sds(4), "table": _sds(16)}, {"idx": (0, 16)}
+
+
+@_range_mutant("unbounded_scan_counter", "overflow")
+def _unbounded_scan_counter():
+    """A u32 accumulator gaining up to 2^16 per iteration over a 2^20-
+    step scan: fine for any single step, 2^36 by the end of the run —
+    only the carry fixpoint's trip-count extrapolation can see it."""
+    import jax
+    import jax.numpy as jnp
+
+    U32 = jnp.uint32
+
+    def fn(inc):
+        def body(c, x):
+            return c + inc[0], x
+
+        return jax.lax.scan(body, U32(0), jnp.zeros((1 << 20,), U32))
+
+    return fn, {"inc": _sds(2)}, {"inc": (0, 1 << 16)}
+
+
+@_range_mutant("int32_byte_size_product", "overflow")
+def _int32_byte_size_product():
+    """A byte-length product computed in int32: 2^20 rows of a 4 KiB
+    bucket row is 2^32 bytes — positive sizes multiply into a negative
+    length."""
+    import jax.numpy as jnp
+
+    def fn(rows):
+        return rows.astype(jnp.int32) * jnp.int32(4096)
+
+    return fn, {"rows": _sds(4)}, {"rows": (0, 1 << 20)}
+
+
 def mutant_names() -> tuple:
     return tuple(_REGISTRY)
+
+
+def range_mutant_names() -> tuple:
+    return tuple(_RANGE_REGISTRY)
+
+
+def run_range_mutants(allowlist=()) -> dict:
+    """Analyze every overflow mutant under ``allowlist``; returns
+    name -> (report, expected_kind, failed_as_expected)."""
+    out = {}
+    for name, (builder, kind) in _RANGE_REGISTRY.items():
+        fn, args, bounds = builder()
+        rep = analyze_ranges(fn, args, bounds, allowlist=allowlist,
+                             name=f"range_mutant/{name}")
+        hit = any(f.kind == kind for f in rep.findings)
+        out[name] = (rep, kind, hit)
+    return out
+
+
+def control_failures(results: dict, flavor: str, log=print) -> list:
+    """Shared mutant-control reporting for both drivers
+    (tools/check_oblivious.py, tools/check_ranges.py): print one status
+    line per mutant via ``log`` and return the not-caught failures.
+    ``flavor`` labels the mutant class (e.g. "mutant", "range mutant");
+    works over both report shapes (oblint ``violations``, rangelint
+    ``findings``)."""
+    failures = []
+    for name, (rep, kind, hit) in results.items():
+        status = "FAIL (expected)" if hit else "PASSED — NO TEETH"
+        log(f"{flavor} {name}: {status}")
+        if not hit:
+            got = [
+                v.kind for v in getattr(rep, "violations", None)
+                or getattr(rep, "findings", [])
+            ]
+            failures.append(
+                f"{flavor} {name!r} was NOT caught (expected a {kind}; "
+                f"got {got})"
+            )
+    return failures
 
 
 def run_mutants(allowlist=()) -> dict:

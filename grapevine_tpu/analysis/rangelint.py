@@ -1,0 +1,842 @@
+"""Rangelint: interval-domain overflow certification of a traced round.
+
+The engine's device lanes are u32 end to end — leaf ids, heap bucket
+ids, block indices, position tables, batch columns — and jnp integer
+arithmetic wraps silently.  ROADMAP item 4 wants capacity at 2^36+
+records, which is exactly where those lanes stop fitting, so "the index
+arithmetic stays inside its dtype at the declared geometry" must be a
+*checked invariant* of the compiled program, the same way Oblint
+(:mod:`.oblint`) made obliviousness one.
+
+This module is an abstract interpreter over the closed jaxpr (walked
+with the same :mod:`.jaxpr_walk` equation stream the taint analyzer and
+the legacy censuses use) with a per-variable integer interval domain:
+every jaxpr var carries one ``[lo, hi]`` over unbounded Python ints
+covering all of its elements.  Geometry-derived input ranges are
+declared where the values enter the program (``RANGELINT_BOUNDS``
+anchors in oram/path_oram.py, oram/posmap.py, engine/round_step.py,
+engine/expiry.py; engine/journal.py carries the host-side byte-length
+guard of the same discipline) and propagated through
+add/mul/shift/concat/cast/gather/scatter, with an affine-widening
+carry fixpoint for ``scan``/``while``.  Three finding classes:
+
+- ``overflow`` — an integer op whose mathematical interval escapes the
+  result dtype: the device value silently wraps (u32 leaf/bucket/index
+  arithmetic past 2^32, int32 counters, reduce/cumsum blowups);
+- ``trunc-cast`` — a narrowing ``convert_element_type`` whose source
+  interval does not fit the target dtype (u32→int32 index conversions
+  are the canonical case: an index that cannot be proven < 2^31 goes
+  negative on the way into a gather);
+- ``oob-index`` — a gather / dynamic-slice start index interval that
+  can exceed the axis extent.  XLA *clamps* these, which hides the bug
+  behind a silently-wrong row.  Scatters in ``FILL_OR_DROP`` mode are
+  exempt: out-of-bounds-drops-the-write is this codebase's documented
+  masking idiom (every ``.at[...]`` site), and the certified property
+  there is that the *drop sentinel itself* fits the index dtype —
+  which the trunc-cast check enforces.
+
+Intentional mod-2^32 arithmetic (ChaCha ARX, the keyed bucket-hash
+mixer, the Feistel PRP, the u64 two-lane carry/borrow helpers) is
+admitted through a reviewed allowlist (:data:`.allowlist.RANGE_ALLOWLIST`)
+reusing Oblint's ``AllowEntry`` keying (``prim@file.py:function``);
+every entry carries a one-line *range argument*, and the driver
+(tools/check_ranges.py) fails the run if an entry is never reached.
+
+Like Oblint, findings can be over-reported but never missed inside the
+modeled fragment: unknown primitives degrade to the full dtype range of
+their outputs (sound, quiet), bitwise ops never flag (their result is
+representable by construction), and interval growth in loop carries is
+extrapolated over the declared trip count before the body is re-walked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+import numpy as np
+
+from .jaxpr_walk import _sub_jaxprs, census, site_of, walk_eqns
+
+#: interval = (lo, hi) Python ints; None = unknown (floats, opaque ops)
+Iv = "tuple[int, int] | None"
+
+#: cap on shift amounts fed to Python ``<<`` during interval math (a
+#: traced shift-by-2^32 must not allocate a billion-bit int)
+_SHIFT_CAP = 128
+
+
+def dtype_range(dtype) -> "tuple[int, int] | None":
+    """Representable range of a dtype: ints/bools get exact bounds,
+    floats/complex return None (no wraparound semantics to certify)."""
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        return None  # extended dtypes (PRNG keys): no lane to certify
+    if dt.kind == "b":
+        return (0, 1)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return (int(info.min), int(info.max))
+    return None
+
+
+def _join(a, b):
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def _clamp(iv, rng):
+    if iv is None or rng is None:
+        return rng
+    return (max(iv[0], rng[0]), min(iv[1], rng[1]))
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeFinding:
+    """One interval escaping its lane (or its axis)."""
+
+    kind: str  # overflow | trunc-cast | oob-index | trace-abort
+    site: str  # "file.py:function" (jaxpr_walk.site_of key)
+    prim: str  # primitive name ("" for trace-level findings)
+    message: str = ""
+
+    def __str__(self) -> str:
+        msg = f" — {self.message}" if self.message else ""
+        return f"{self.kind}: {self.prim or '<trace>'} at {self.site}{msg}"
+
+
+@dataclasses.dataclass
+class RangeReport:
+    """Outcome of one analysis: surviving findings, allowlist hits
+    (entry.key -> count), and the traced program's primitive census."""
+
+    name: str
+    findings: list
+    allowed: dict
+    census: dict
+    n_eqns: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def summary(self) -> str:
+        lines = [
+            f"[rangelint] {self.name}: {len(self.findings)} finding(s), "
+            f"{sum(self.allowed.values())} allowlisted op(s) at "
+            f"{len(self.allowed)} site(s), {self.n_eqns} equations"
+        ]
+        lines += [f"  FINDING {f}" for f in self.findings]
+        return "\n".join(lines)
+
+
+def _line_of(eqn, pkg: str = "grapevine_tpu") -> str:
+    """``:line`` of the innermost user frame, for finding messages only
+    (allowlist keys stay line-free so they survive churn)."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    for fr in (tb.frames if tb is not None else []):
+        fn = fr.file_name.replace("\\", "/")
+        if fn.endswith("analysis/oblint.py") or \
+                fn.endswith("analysis/rangelint.py"):
+            continue
+        if f"/{pkg}/" in fn or ("site-packages" not in fn
+                                and "/jax/" not in fn):
+            line = getattr(fr, "line_num", None)
+            return f" (line {line})" if line else ""
+    return ""
+
+
+class _Ctx:
+    """Mutable walk state: findings dedup + allowlist hit counts, plus
+    the walk's shared value environment. ``env``/``alias``/``preds``
+    span every (sub-)jaxpr of one analysis — jaxpr vars are globally
+    unique objects, and sub-jaxpr invars *alias* their caller atoms
+    (when arities match) so comparison provenance survives pjit
+    boundaries (jnp.where wraps its select_n in a ``pjit[_where]``)."""
+
+    def __init__(self, allowlist: Iterable):
+        self.allowlist = tuple(allowlist)
+        self.findings: dict = {}  # (kind, site, prim) -> RangeFinding
+        self.allowed: dict = {}
+        self.env: dict = {}  # var -> Iv
+        self.alias: dict = {}  # sub-jaxpr invar -> caller atom
+        self.preds: dict = {}  # pred var -> (rel, a_atom, b_atom)
+
+    def flag(self, kind: str, eqn, message: str):
+        f = RangeFinding(
+            kind=kind, site=site_of(eqn), prim=eqn.primitive.name,
+            message=message + _line_of(eqn),
+        )
+        import os
+        if os.environ.get("GRAPEVINE_RANGELINT_DEBUG"):  # pragma: no cover
+            print(f"[rangelint-debug] {f}\n  eqn: {eqn}")
+        for entry in self.allowlist:
+            if entry.matches(f):
+                self.allowed[entry.key] = self.allowed.get(entry.key, 0) + 1
+                return
+        # first (narrowest-interval) message wins; later passes only
+        # widen the same site
+        self.findings.setdefault((f.kind, f.site, f.prim), f)
+
+
+def _lit_interval(val) -> Iv:
+    a = np.asarray(val)
+    if a.dtype.kind in "iub":
+        if a.size == 0:
+            return (0, 0)
+        return (int(a.min()), int(a.max()))
+    return None
+
+
+def _checked(ctx, eqn, iv, rng, what: str) -> Iv:
+    """Flag ``iv`` escaping ``rng`` (the result dtype), then clamp: a
+    wrapped lane can hold anything representable, nothing more."""
+    if iv is None or rng is None:
+        return rng
+    if iv[0] < rng[0] or iv[1] > rng[1]:
+        ctx.flag(
+            "overflow", eqn,
+            f"{what}: interval [{iv[0]}, {iv[1]}] escapes "
+            f"{eqn.outvars[0].aval.dtype} [{rng[0]}, {rng[1]}] — the "
+            "lane wraps silently at this geometry",
+        )
+        return rng
+    return iv
+
+
+def _shift_candidates(a: Iv, s: Iv, op) -> Iv:
+    if a is None or s is None:
+        return None
+    s_lo = max(0, min(s[0], _SHIFT_CAP))
+    s_hi = max(0, min(s[1], _SHIFT_CAP))
+    cands = [op(x, y) for x in a for y in (s_lo, s_hi)]
+    return (min(cands), max(cands))
+
+
+def _decide(rel: str, a: Iv, b: Iv) -> "bool | None":
+    """Truth value of an elementwise comparison decidable from the
+    operand intervals alone; None = undecidable."""
+    if a is None or b is None:
+        return None
+    if rel == "lt":
+        if a[1] < b[0]:
+            return True
+        if a[0] >= b[1]:
+            return False
+    elif rel == "le":
+        if a[1] <= b[0]:
+            return True
+        if a[0] > b[1]:
+            return False
+    elif rel == "gt":
+        if a[0] > b[1]:
+            return True
+        if a[1] <= b[0]:
+            return False
+    elif rel == "ge":
+        if a[0] >= b[1]:
+            return True
+        if a[1] < b[0]:
+            return False
+    elif rel == "eq":
+        if a[1] < b[0] or a[0] > b[1]:
+            return False
+        if a[0] == a[1] == b[0] == b[1]:
+            return True
+    elif rel == "ne":
+        if a[1] < b[0] or a[0] > b[1]:
+            return True
+        if a[0] == a[1] == b[0] == b[1]:
+            return False
+    return None
+
+
+def _bitwidth_bound(a: Iv, b: Iv) -> Iv:
+    """or/xor of nonnegative ints: bounded by the next all-ones mask."""
+    hi = max(a[1], b[1])
+    return (0, (1 << max(1, hi.bit_length())) - 1)
+
+
+def _index_extent(eqn) -> "tuple[int, int] | None":
+    """Allowed start-index range for a gather/dynamic-slice eqn, or
+    None when the op should not be checked (drop-mode scatters)."""
+    name = eqn.primitive.name
+    mode = eqn.params.get("mode")
+    is_drop = mode is not None and getattr(mode, "name", "") == "FILL_OR_DROP"
+    if name == "gather":
+        if is_drop:
+            return None  # explicit fill semantics: OOB reads the fill
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = eqn.params["slice_sizes"]
+        op_shape = eqn.invars[0].aval.shape
+        dims = dnums.start_index_map
+        if not dims:
+            return None
+        # one mapped dim = exact; several = the loosest extent (an
+        # exceedance of the loosest bound is OOB on every column)
+        hi = max(op_shape[d] - slice_sizes[d] for d in dims)
+        return (0, hi)
+    if name.startswith("scatter"):
+        if is_drop:
+            return None  # OOB-drops-the-write: the masking idiom
+        dnums = eqn.params["dimension_numbers"]
+        op_shape = eqn.invars[0].aval.shape
+        dims = dnums.scatter_dims_to_operand_dims
+        if not dims:
+            return None
+        return (0, max(op_shape[d] - 1 for d in dims))
+    return None
+
+
+def _propagate(closed, in_ivs, ctx: _Ctx, in_atoms=None) -> list:
+    """Walk one (closed) jaxpr, return per-outvar intervals.
+
+    ``in_atoms`` (pjit-style nesting with matching arity) aliases the
+    body's invars to the caller's atoms instead of binding values, so
+    comparison provenance — "this var IS the var that was compared" —
+    survives the boundary; ``in_ivs`` (top level, loop carries) binds
+    concrete intervals."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    env, alias, preds = ctx.env, ctx.alias, ctx.preds
+
+    def resolve(atom):
+        while not hasattr(atom, "val") and atom in alias:
+            atom = alias[atom]
+        return atom
+
+    def read(atom) -> Iv:
+        atom = resolve(atom)
+        if hasattr(atom, "val"):
+            return _lit_interval(atom.val)
+        return env.get(atom, dtype_range(atom.aval.dtype))
+
+    def narrow(case_atom, civ: Iv, rel, truth: bool) -> Iv:
+        """Narrow a select case's interval by the select predicate."""
+        case_atom = resolve(case_atom)
+        if civ is None or hasattr(case_atom, "val"):
+            return civ
+        rel_name, a_atom, b_atom = rel
+        if case_atom is a_atom:
+            other, flip = b_atom, False
+        elif case_atom is b_atom:
+            other, flip = a_atom, True
+        else:
+            return civ
+        biv = read(other)
+        if biv is None:
+            return civ
+        # normalize to "case REL other": flipping swaps lt<->gt, le<->ge
+        r = rel_name
+        if flip:
+            r = {"lt": "gt", "gt": "lt", "le": "ge", "ge": "le"}.get(r, r)
+        if not truth:
+            r = {"lt": "ge", "ge": "lt", "le": "gt", "gt": "le",
+                 "eq": "ne", "ne": "eq"}.get(r, r)
+        lo, hi = civ
+        if r == "lt":
+            hi = min(hi, biv[1] - 1)
+        elif r == "le":
+            hi = min(hi, biv[1])
+        elif r == "gt":
+            lo = max(lo, biv[0] + 1)
+        elif r == "ge":
+            lo = max(lo, biv[0])
+        elif r == "eq":
+            lo, hi = max(lo, biv[0]), min(hi, biv[1])
+        if lo > hi:  # contradictory branch: never taken; keep sound
+            return civ
+        return (lo, hi)
+
+    def write(var, iv):
+        env[var] = _clamp(iv, dtype_range(var.aval.dtype))
+
+    if in_atoms is not None:
+        for v, atom in zip(jaxpr.invars, in_atoms):
+            alias[v] = atom
+            env.pop(v, None)  # aliased: resolve fresh through the caller
+    else:
+        for v, iv in zip(jaxpr.invars, in_ivs):
+            alias.pop(v, None)  # re-bound (loop carry): value, not alias
+            write(v, iv)
+    for v, c in zip(jaxpr.constvars, getattr(closed, "consts", ())):
+        write(v, _lit_interval(c))
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [read(a) for a in eqn.invars]
+        out_rngs = [dtype_range(v.aval.dtype) for v in eqn.outvars]
+        outs: "list | None" = None
+
+        def binop(f) -> Iv:
+            a, b = ins[0], ins[1]
+            if a is None or b is None:
+                return None
+            cands = [f(x, y) for x in a for y in b]
+            return (min(cands), max(cands))
+
+        # ---- arithmetic: exact interval, wraparound check --------------
+        if name == "add":
+            outs = [_checked(ctx, eqn, binop(lambda x, y: x + y),
+                             out_rngs[0], "add")]
+        elif name == "sub":
+            a, b = ins[0], ins[1]
+            iv = None if a is None or b is None else (a[0] - b[1], a[1] - b[0])
+            outs = [_checked(ctx, eqn, iv, out_rngs[0], "sub")]
+        elif name == "mul":
+            outs = [_checked(ctx, eqn, binop(lambda x, y: x * y),
+                             out_rngs[0], "mul")]
+        elif name == "neg":
+            a = ins[0]
+            iv = None if a is None else (-a[1], -a[0])
+            outs = [_checked(ctx, eqn, iv, out_rngs[0], "neg")]
+        elif name == "integer_pow":
+            a, y = ins[0], eqn.params["y"]
+            iv = None
+            if a is not None and y >= 0:
+                cands = [x ** y for x in a] + ([0] if a[0] < 0 < a[1] else [])
+                iv = (min(cands), max(cands))
+            outs = [_checked(ctx, eqn, iv, out_rngs[0], "integer_pow")]
+        elif name == "shift_left":
+            iv = _shift_candidates(ins[0], ins[1], lambda x, s: x << s)
+            outs = [_checked(ctx, eqn, iv, out_rngs[0], "shift_left")]
+        elif name in ("shift_right_logical", "shift_right_arithmetic"):
+            a, s = ins[0], ins[1]
+            if a is not None and s is not None and a[0] >= 0:
+                outs = [(a[0] >> max(0, min(s[1], _SHIFT_CAP)),
+                         a[1] >> max(0, min(s[0], _SHIFT_CAP)))]
+            elif name == "shift_right_arithmetic":
+                outs = [_shift_candidates(a, s, lambda x, sh: x >> sh)]
+            else:
+                outs = [out_rngs[0]]  # logical shift of a negative: bits
+        elif name == "div":
+            a, b = ins[0], ins[1]
+            if a is None or b is None or b[0] <= 0 <= b[1]:
+                outs = [out_rngs[0]]
+            else:
+                # truncation toward zero in exact integer arithmetic
+                # (float division would round above 2^53)
+                cands = [
+                    -(-x // y) if (x < 0) != (y < 0) else x // y
+                    for x in a for y in b
+                ]
+                outs = [(min(cands), max(cands))]
+        elif name == "rem":
+            a, b = ins[0], ins[1]
+            if a is not None and b is not None and a[0] >= 0 and b[0] >= 1:
+                outs = [(0, min(a[1], b[1] - 1))]
+            else:
+                outs = [out_rngs[0]]  # rem always fits its dtype
+        elif name == "max":
+            a, b = ins[0], ins[1]
+            outs = [None if a is None or b is None
+                    else (max(a[0], b[0]), max(a[1], b[1]))]
+        elif name == "min":
+            a, b = ins[0], ins[1]
+            outs = [None if a is None or b is None
+                    else (min(a[0], b[0]), min(a[1], b[1]))]
+        elif name == "clamp":
+            lo, x, hi = ins[0], ins[1], ins[2]
+            if None in (lo, x, hi):
+                outs = [None]
+            else:
+                outs = [(min(max(x[0], lo[0]), hi[0]),
+                         min(max(x[1], lo[1]), hi[1]))]
+        elif name in ("and", "or", "xor"):
+            a, b = ins[0], ins[1]
+            if a is None or b is None or a[0] < 0 or b[0] < 0:
+                outs = [out_rngs[0]]  # bitwise never escapes its dtype
+            elif name == "and":
+                outs = [(0, min(a[1], b[1]))]
+            else:
+                outs = [_bitwidth_bound(a, b)]
+        elif name == "not":
+            outs = [out_rngs[0]]
+
+        # ---- casts -----------------------------------------------------
+        elif name == "convert_element_type":
+            src = eqn.invars[0].aval.dtype
+            iv, rng = ins[0], out_rngs[0]
+            if (iv is not None and rng is not None
+                    and np.dtype(src).kind in "iub"
+                    and (iv[0] < rng[0] or iv[1] > rng[1])):
+                ctx.flag(
+                    "trunc-cast", eqn,
+                    f"narrowing {src}->{eqn.outvars[0].aval.dtype}: source "
+                    f"interval [{iv[0]}, {iv[1]}] does not fit "
+                    f"[{rng[0]}, {rng[1]}] — values truncate/wrap",
+                )
+                outs = [rng]
+            else:
+                outs = [_clamp(iv, rng) if rng is not None else None]
+        elif name == "bitcast_convert_type":
+            outs = [out_rngs[0]]
+
+        # ---- comparisons / structure ----------------------------------
+        elif name in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+            if name != "is_finite":
+                preds[eqn.outvars[0]] = (
+                    name, resolve(eqn.invars[0]), resolve(eqn.invars[1])
+                )
+            outs = [(0, 1)]
+        elif name == "select_n":
+            pred = resolve(eqn.invars[0])
+            rel = None if hasattr(pred, "val") else preds.get(pred)
+            cases = eqn.invars[1:]
+            # decidable predicate ⇒ one branch is dead and must not
+            # pollute the union (jnp lowers x[i] with a signed index to
+            # select(i < 0, i + n, i): for i provably >= 0 the i+n
+            # branch is unreachable)
+            decided = None
+            if rel is not None and len(cases) == 2:
+                decided = _decide(rel[0], read(rel[1]), read(rel[2]))
+            iv = None
+            for ci, case in enumerate(cases):
+                if decided is not None and ci != int(decided):
+                    continue
+                civ = read(case)
+                if rel is not None and len(cases) == 2:
+                    # select_n(pred, on_false, on_true)
+                    civ = narrow(case, civ, rel, truth=(ci == 1))
+                iv = civ if iv is None else _join(iv, civ)
+            outs = [iv if cases else out_rngs[0]]
+        elif name in ("broadcast_in_dim", "reshape", "transpose", "squeeze",
+                      "rev", "copy", "stop_gradient", "slice",
+                      "expand_dims", "device_put", "reduce_precision",
+                      "optimization_barrier"):
+            if name in ("broadcast_in_dim", "reshape", "squeeze",
+                        "expand_dims", "copy") and eqn.invars:
+                src = resolve(eqn.invars[0])
+                if not hasattr(src, "val") and src in preds:
+                    preds[eqn.outvars[0]] = preds[src]
+            outs = list(ins[: len(eqn.outvars)]) or [out_rngs[0]]
+        elif name == "concatenate":
+            iv = ins[0]
+            for other in ins[1:]:
+                iv = _join(iv, other)
+            outs = [iv]
+        elif name == "pad":
+            outs = [_join(ins[0], ins[1])]
+        elif name == "iota":
+            dim = eqn.params["dimension"]
+            n = eqn.outvars[0].aval.shape[dim]
+            outs = [_checked(ctx, eqn, (0, max(0, n - 1)), out_rngs[0],
+                             "iota")]
+        elif name == "sort":
+            outs = list(ins)
+
+        # ---- reductions / scans over axes -----------------------------
+        elif name in ("reduce_sum", "cumsum"):
+            a = ins[0]
+            if name == "reduce_sum":
+                shape = eqn.invars[0].aval.shape
+                n = 1
+                for ax in eqn.params["axes"]:
+                    n *= shape[ax]
+            else:
+                n = eqn.invars[0].aval.shape[eqn.params["axis"]]
+            iv = None if a is None else (min(n * a[0], a[0]),
+                                         max(n * a[1], a[1]))
+            outs = [_checked(ctx, eqn, iv, out_rngs[0], f"{name}[n={n}]")]
+        elif name in ("reduce_max", "reduce_min", "cummax", "cummin",
+                      "reduce_and", "reduce_or"):
+            outs = [ins[0]]
+        elif name == "reduce_prod":
+            outs = [out_rngs[0]]
+        elif name in ("argmax", "argmin"):
+            shape = eqn.invars[0].aval.shape
+            hi = max(shape[ax] for ax in eqn.params["axes"]) - 1
+            outs = [(0, max(0, hi))]
+
+        # ---- memory ops: index checks ---------------------------------
+        elif name == "gather" or name.startswith("scatter"):
+            extent = _index_extent(eqn)
+            idx = ins[1]
+            if extent is not None and idx is not None and (
+                    idx[0] < extent[0] or idx[1] > extent[1]):
+                ctx.flag(
+                    "oob-index", eqn,
+                    f"index interval [{idx[0]}, {idx[1]}] can leave the "
+                    f"axis extent [{extent[0]}, {extent[1]}] "
+                    f"(operand {tuple(eqn.invars[0].aval.shape)}, "
+                    f"indices {tuple(eqn.invars[1].aval.shape)}) — XLA "
+                    "clamps, silently reading/writing the wrong row",
+                )
+            a, u = ins[0], (ins[2] if len(ins) > 2 else None)
+            if name == "gather":
+                outs = [ins[0]]
+            elif name == "scatter":
+                outs = [_join(a, u)]
+            elif name == "scatter-min" and a is not None and u is not None:
+                # each element is op or min(op, some updates)
+                outs = [(min(a[0], u[0]), a[1])]
+            elif name == "scatter-max" and a is not None and u is not None:
+                outs = [(a[0], max(a[1], u[1]))]
+            elif name == "scatter-add" and a is not None and u is not None:
+                # worst case every update lands on one element
+                n = 1
+                for d in eqn.invars[2].aval.shape:
+                    n *= d
+                iv = (a[0] + min(0, n * u[0]), a[1] + max(0, n * u[1]))
+                outs = [_checked(ctx, eqn, iv, out_rngs[0],
+                                 f"scatter-add[n={n}]")]
+            else:  # scatter-mul and friends: full range, quiet
+                outs = [out_rngs[0]]
+        elif name == "dynamic_slice":
+            op_shape = eqn.invars[0].aval.shape
+            sizes = eqn.params["slice_sizes"]
+            for d, start in enumerate(ins[1:]):
+                hi = op_shape[d] - sizes[d]
+                if start is not None and (start[0] < 0 or start[1] > hi):
+                    ctx.flag(
+                        "oob-index", eqn,
+                        f"slice start (dim {d}) interval "
+                        f"[{start[0]}, {start[1]}] can leave [0, {hi}] — "
+                        "XLA clamps, silently reading the wrong window",
+                    )
+            outs = [ins[0]]
+        elif name == "dynamic_update_slice":
+            op_shape = eqn.invars[0].aval.shape
+            upd_shape = eqn.invars[1].aval.shape
+            for d, start in enumerate(ins[2:]):
+                hi = op_shape[d] - upd_shape[d]
+                if start is not None and (start[0] < 0 or start[1] > hi):
+                    ctx.flag(
+                        "oob-index", eqn,
+                        f"update start (dim {d}) interval "
+                        f"[{start[0]}, {start[1]}] can leave [0, {hi}] — "
+                        "XLA clamps, silently writing the wrong window",
+                    )
+            outs = [_join(ins[0], ins[1])]
+
+        # ---- control flow ---------------------------------------------
+        elif name == "cond":
+            bouts = None
+            for br in eqn.params["branches"]:
+                res = _propagate(br, ins[1:], ctx)
+                bouts = res if bouts is None else [
+                    _join(a, b) for a, b in zip(bouts, res)
+                ]
+            outs = bouts or []
+        elif name == "while":
+            ncc = eqn.params["cond_nconsts"]
+            nbc = eqn.params["body_nconsts"]
+            cond_c, body_c = ins[:ncc], ins[ncc:ncc + nbc]
+            carry = list(ins[ncc + nbc:])
+            body_vars = eqn.params["body_jaxpr"].jaxpr.invars[nbc:]
+            for _ in range(3):
+                nxt = _propagate(eqn.params["body_jaxpr"], body_c + carry, ctx)
+                merged = [_join(a, b) for a, b in zip(carry, nxt)]
+                if merged == carry:
+                    break
+                carry = merged
+            else:
+                # no fixpoint in 3 joins: the carry is unbounded by the
+                # loop itself — widen to the lane and re-walk (in-body
+                # ops past the lane get flagged there)
+                carry = [dtype_range(v.aval.dtype) for v in body_vars]
+                _propagate(eqn.params["body_jaxpr"], body_c + carry, ctx)
+            _propagate(eqn.params["cond_jaxpr"], cond_c + carry, ctx)
+            outs = carry
+        elif name == "scan":
+            outs = _scan_transfer(eqn, ins, ctx)
+
+        # ---- nesting / default ----------------------------------------
+        else:
+            subs = list(_sub_jaxprs(eqn))
+            if subs:
+                outs = None
+                for sub in subs:
+                    n_in = len(getattr(sub, "jaxpr", sub).invars)
+                    if n_in == len(ins):
+                        # pjit-style body: alias invars to our atoms so
+                        # value AND provenance flow through
+                        souts = _propagate(
+                            sub, None, ctx, in_atoms=list(eqn.invars)
+                        )
+                    else:
+                        souts = _propagate(sub, [None] * n_in, ctx)
+                    outs = souts if outs is None else [
+                        _join(a, b) for a, b in zip(outs, souts)
+                    ]
+                if len(outs or []) != len(eqn.outvars):
+                    outs = out_rngs
+            else:
+                # unknown primitive (PRNG cores, callbacks, custom
+                # kernels): full lane range — sound and quiet
+                outs = out_rngs
+
+        for var, iv in zip(eqn.outvars, outs):
+            write(var, iv)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _scan_transfer(eqn, ins: list, ctx: _Ctx) -> list:
+    """Scan carry fixpoint with affine widening over the trip count.
+
+    One body pass measures per-iteration growth; affine growth is
+    extrapolated over ``length`` iterations (so a counter adding at most
+    g per chunk certifies at carry0 + length·g, exactly); accelerating
+    growth widens to the lane.  A carry whose extrapolated interval
+    escapes its dtype is itself an ``overflow`` finding at the scan
+    site — the "unbounded scan counter" class."""
+    p = eqn.params
+    nc, ncar = p["num_consts"], p["num_carry"]
+    length = p["length"]
+    consts, carry0 = ins[:nc], list(ins[nc:nc + ncar])
+    xs = ins[nc + ncar:]
+    carry_vars = p["jaxpr"].jaxpr.invars[nc:nc + ncar]
+
+    res = _propagate(p["jaxpr"], consts + carry0 + xs, ctx)
+    nxt = res[:ncar]
+    joined = [_join(a, b) for a, b in zip(carry0, nxt)]
+    if joined == carry0:
+        return carry0 + res[ncar:]
+
+    # second pass from the join: growth per iteration, and whether the
+    # growth itself is stable (affine) or accelerating
+    res2 = _propagate(p["jaxpr"], consts + joined + xs, ctx)
+    nxt2 = res2[:ncar]
+    carry_fix: list = []
+    growths: list = []
+    for c0, j, n2, var in zip(carry0, joined, nxt2, carry_vars):
+        rng = dtype_range(var.aval.dtype)
+        if j is None or n2 is None:
+            carry_fix.append(rng)
+            growths.append(None)
+            continue
+        g_hi = max(0, n2[1] - j[1])
+        g_lo = max(0, j[0] - n2[0])
+        first_hi = 0 if c0 is None else max(0, j[1] - c0[1])
+        first_lo = 0 if c0 is None else max(0, c0[0] - j[0])
+        if g_hi > first_hi or g_lo > first_lo:
+            # accelerating (e.g. doubling): no affine bound — the lane
+            carry_fix.append(rng)
+            growths.append(None)
+            continue
+        ext = (j[0] - length * g_lo, j[1] + length * g_hi)
+        if rng is not None and (ext[0] < rng[0] or ext[1] > rng[1]):
+            ctx.flag(
+                "overflow", eqn,
+                f"scan carry grows to [{ext[0]}, {ext[1]}] over "
+                f"{length} iterations, escaping {var.aval.dtype} "
+                f"[{rng[0]}, {rng[1]}] — an unbounded counter at this "
+                "geometry",
+            )
+        carry_fix.append(_clamp(ext, rng) if rng is not None else ext)
+        growths.append((g_lo, g_hi))
+    # verification pass: the extrapolation is only sound if it is
+    # INDUCTIVE — growth measured FROM the extrapolated carry must not
+    # exceed the rate measured near carry0. A carry-derived increment
+    # (c + (c >> 10): exponential, but flat across two narrow passes)
+    # fails this and widens to the lane, so the wrap gets flagged
+    # inside the body instead of certified away.
+    res3 = _propagate(p["jaxpr"], consts + carry_fix + xs, ctx)
+    nxt3 = res3[:ncar]
+    widened = False
+    final: list = []
+    for cf, n3, g, var in zip(carry_fix, nxt3, growths, carry_vars):
+        rng = dtype_range(var.aval.dtype)
+        if g is None or cf is None or n3 is None:
+            final.append(cf)
+            continue
+        g_lo2 = max(0, cf[0] - n3[0])
+        g_hi2 = max(0, n3[1] - cf[1])
+        if g_hi2 > g[1] or g_lo2 > g[0]:
+            final.append(rng)
+            widened = True
+        else:
+            final.append(cf)
+    if widened:
+        res3 = _propagate(p["jaxpr"], consts + final + xs, ctx)
+    return final + res3[ncar:]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return ".".join(parts)
+
+
+def _bound_for(label: str, bounds: dict) -> Iv:
+    """Longest declared dotted-prefix match wins; None = lane default."""
+    best = None
+    best_len = -1
+    for prefix, iv in bounds.items():
+        if (label == prefix or label.startswith(prefix + ".")) \
+                and len(prefix) > best_len:
+            best, best_len = iv, len(prefix)
+    return best
+
+
+def analyze_ranges(
+    fn: Callable,
+    args: dict,
+    bounds: "dict | None" = None,
+    allowlist: Iterable = (),
+    name: str = "program",
+) -> RangeReport:
+    """Trace ``fn(*args.values())`` and interval-check the closed jaxpr.
+
+    ``args`` maps argument name -> example value (ShapeDtypeStructs or
+    pytrees of them).  ``bounds`` maps dotted label prefixes over those
+    names (``"idxs"``, ``"state.rec.posmap"``) to declared ``(lo, hi)``
+    input intervals — the RANGELINT_BOUNDS anchors; undeclared leaves
+    default to their full lane range (sound: certify what you declare).
+
+    A geometry that cannot even trace (a construction-time guard fired,
+    a numpy conversion refused an out-of-range literal) is converted
+    into a ``trace-abort`` finding rather than crashing the audit."""
+    import jax
+    from jax import tree_util as jtu
+
+    bounds = dict(bounds or {})
+    ctx = _Ctx(allowlist)
+    values = list(args.values())
+    try:
+        closed = jax.make_jaxpr(fn)(*values)
+    except (OverflowError, ValueError) as exc:
+        f = RangeFinding(
+            kind="trace-abort", site=name, prim="",
+            message=(
+                "tracing aborted before any device op: "
+                f"{type(exc).__name__}: {exc}"
+            ),
+        )
+        return RangeReport(name, [f], {}, {})
+
+    in_ivs: list = []
+    for argname, val in args.items():
+        for path, leaf in jtu.tree_flatten_with_path(val)[0]:
+            sub = _path_str(path)
+            label = f"{argname}.{sub}" if sub else argname
+            declared = _bound_for(label, bounds)
+            if declared is not None:
+                in_ivs.append((int(declared[0]), int(declared[1])))
+            else:
+                in_ivs.append(dtype_range(leaf.dtype))
+    if len(in_ivs) != len(closed.jaxpr.invars):
+        raise ValueError(
+            f"rangelint: {len(in_ivs)} flattened args vs "
+            f"{len(closed.jaxpr.invars)} jaxpr invars — static/implicit "
+            "arguments must be closed over, not passed"
+        )
+    _propagate(closed, in_ivs, ctx)
+    return RangeReport(
+        name=name,
+        findings=sorted(
+            ctx.findings.values(), key=lambda f: (f.site, f.kind, f.prim)
+        ),
+        allowed=dict(ctx.allowed),
+        census=dict(census(closed)),
+        n_eqns=sum(1 for _ in walk_eqns(closed)),
+    )

@@ -59,6 +59,21 @@ OBLINT_SECRETS = (
 )
 
 
+def RANGELINT_BOUNDS(ecfg: EngineConfig) -> dict:
+    """Rangelint input-interval anchors for ``expiry_sweep(ecfg, state,
+    now, period, now_hi)``: the same per-plane state invariants as the
+    engine round (imported, so a new plane cannot be bounded in one
+    audit and forgotten in the other). ``now``/``period`` are the
+    untrusted host clock — full lane, never assumed. The sweep's own
+    counters (chunk liveness, recipient recount) are *derived* bounded:
+    the scan-carry fixpoint extrapolates their per-chunk budget over
+    the chunk count, which tops out at total tree slots ≪ 2^32 at
+    every certified geometry."""
+    from .round_step import RANGELINT_BOUNDS as _rs_bounds
+
+    return _rs_bounds(ecfg)
+
+
 def _expired(ts_lo, ts_hi, now_lo, now_hi, period) -> jnp.ndarray:
     """Strict '>' age test over u64 lane pairs (now - ts > period).
 
@@ -172,7 +187,12 @@ def expiry_sweep(
         live = ix != SENTINEL
         dead = live & _expired(ts_lo, ts_hi, now, now_hi, period)
         ix = jnp.where(dead, SENTINEL, ix)
-        safe = jnp.where(ix != SENTINEL, ix, U32(n_msgs)).reshape(-1)
+        # decrypted slot ids are opaque to interval reasoning; the min
+        # keeps the liveness index inside the int32 scatter lane
+        # (garbage >= n_msgs still drops — same OOB row as the sentinel)
+        safe = jnp.minimum(
+            jnp.where(ix != SENTINEL, ix, U32(n_msgs)), U32(n_msgs)
+        ).reshape(-1)
         present = present.at[safe].set(True, mode="drop")
         return present, (ix, vl)
 
@@ -202,7 +222,10 @@ def expiry_sweep(
         now, now_hi, period,
     )
     rec_stash_idx = jnp.where(st_dead, SENTINEL, state.rec.stash_idx)
-    safe = jnp.where(rec_stash_idx != SENTINEL, rec_stash_idx, U32(n_msgs))
+    safe = jnp.minimum(
+        jnp.where(rec_stash_idx != SENTINEL, rec_stash_idx, U32(n_msgs)),
+        U32(n_msgs),
+    )
     present = present.at[safe].set(True, mode="drop")
     rec = rec._replace(stash_idx=rec_stash_idx)
 

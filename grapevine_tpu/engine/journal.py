@@ -123,6 +123,20 @@ class BatchJournal:
         round_body = 17 + 4 * ecfg.batch_size * sum(
             w for _, w in _ROUND_COLS
         )
+        # RANGELINT_BOUNDS (host prong, analysis/rangelint.py): the
+        # frame header's blob_len is u32 on the wire. Host-side byte
+        # products are unbounded Python ints, so the one real ceiling
+        # is this format field — refuse at construction rather than
+        # truncate a frame length at append time (a torn-tail that
+        # replay could never tell from corruption). ~2^20-op batches of
+        # 2 KiB records are still an order of magnitude below it.
+        if round_body + _SEAL_OVERHEAD > 0xFFFFFFFF:
+            raise ValueError(
+                f"journal frame for batch_size {ecfg.batch_size} would "
+                f"be {round_body + _SEAL_OVERHEAD} bytes — past the u32 "
+                "blob_len wire field (rangelint certified bound, "
+                "OPERATIONS.md §18); shard the batch instead"
+            )
         self._valid_blob_lens = frozenset(
             body + _SEAL_OVERHEAD for body in (round_body, 13)
         )

@@ -92,6 +92,34 @@ OBLINT_SECRETS = (
 )
 
 
+def RANGELINT_BOUNDS(ecfg: EngineConfig) -> dict:
+    """Rangelint input-interval anchors (analysis/rangelint.py) for one
+    full engine round ``engine_round_step(ecfg, state, batch)`` — the
+    geometry-derived invariants where values enter the compiled round:
+
+    - both trees' private planes carry the per-plane bounds of
+      :func:`oram.path_oram.RANGELINT_BOUNDS` (position values below
+      their leaf counts; ciphertext opaque);
+    - the freelist holds block ids, ``free_top`` counts at most
+      ``max_messages`` of them (stack invariant: pushes are exactly the
+      oracle-pinned deletes), ``recipients`` is capped by admission at
+      ``max_recipients`` — the counters' per-run increment budget is one
+      batch (≤ B), which the u32 lane absorbs with 2^31 of margin;
+    - batch columns, the u64 clock lanes, and the seq counter stay at
+      the full lane (untrusted inputs / two-lane counters whose wrap is
+      the allowlisted carry idiom).
+    """
+    from ..oram.path_oram import RANGELINT_BOUNDS as tree_bounds
+
+    return {
+        **tree_bounds(ecfg.rec, prefix="state.rec"),
+        **tree_bounds(ecfg.mb, prefix="state.mb"),
+        "state.freelist": (0, ecfg.max_messages - 1),
+        "state.free_top": (0, ecfg.max_messages),
+        "state.recipients": (0, ecfg.max_recipients),
+    }
+
+
 def transcript_key_groups(batch: dict, mb_choices: int):
     """Host-side mirror of this step's key selection, for the leak
     monitor (obs/leakmon.py).
@@ -236,9 +264,19 @@ def engine_round_step(
 
     # allocation candidates: the top B free blocks, pre-gathered so the
     # freelist array never enters device decision logic (vphases assigns
-    # the n-th successful create candidate n)
+    # the n-th successful create candidate n). The rank arithmetic uses
+    # the +max_messages modular bias so lanes past the stack top never
+    # wrap below zero in u32 (free_top + mm - 1 <= 2^31 - 1 at the
+    # certified blocks <= 2^30 bound; the & mask is mod mm) — bit-
+    # identical to free_top-1-ks on every selected lane, and interval-
+    # transparent to rangelint instead of a masked wraparound.
     ks = jnp.arange(b, dtype=U32)
-    cand_pos = jnp.where(ks < state.free_top, state.free_top - U32(1) - ks, 0)
+    mm_mask = U32(ecfg.max_messages - 1)
+    cand_pos = jnp.where(
+        ks < state.free_top,
+        (state.free_top + mm_mask - ks) & mm_mask,
+        U32(0),
+    )
     cand_idx = state.freelist[cand_pos]
 
     # ---- round A: mailbox (capacity, append, zero-id select/pop) ------
@@ -272,7 +310,13 @@ def engine_round_step(
             occ_impl=ecfg.vphases_impl, sort_impl=ecfg.sort_impl,
             pm_new_leaves=pm["a"][0], pm_dummy_leaves=pm["a"][1],
         )
-    free_top = state.free_top - out_a["n_allocs"]
+    # n_allocs <= free_top by phase-A admission (the quota invariant the
+    # oracle-equality suites pin), so the subtraction cannot wrap; that
+    # argument lives in RANGE_ALLOWLIST, and the min re-establishes the
+    # stack bound for interval reasoning downstream (identity at runtime)
+    free_top = jnp.minimum(
+        state.free_top - out_a["n_allocs"], U32(ecfg.max_messages)
+    )
     recipients = state.recipients + out_a["n_claims"]
     seq_lo, seq_hi = u64_add_u32(state.seq[0], state.seq[1], U32(b))
     seq = jnp.stack([seq_lo, seq_hi])

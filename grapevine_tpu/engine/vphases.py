@@ -651,7 +651,14 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
 
         # --- allocation + ids (n-th successful create takes candidate n)
         grank = rank_of(create_ok)
-        alloc_idx = ctx["cand_idx"][jnp.minimum(grank, b - 1)]
+        # clamp to the CANDIDATE array's extent, not this round's lane
+        # count: under mailbox_choices=2 the lanes are B·D wide while
+        # cand_idx is B wide, so `b - 1` let non-create lanes index past
+        # the array (formally UB under PROMISE_IN_BOUNDS; XLA happened
+        # to clamp). Create lanes always rank < B — the quota caps
+        # successful creates at the batch size (rangelint finding).
+        cand_cap = ctx["cand_idx"].shape[0] - 1
+        alloc_idx = ctx["cand_idx"][jnp.minimum(grank, cand_cap)]
         # id words 0-1 = PRP-encrypted (nonce, block index): decodable
         # on-device, fresh random-looking values on every create even
         # when the LIFO freelist reuses a block (oblivious/prp.py; the
@@ -760,7 +767,13 @@ def phase_a_batch(ecfg: EngineConfig, ctx: dict):
         popped_init_r = jnp.minimum(T_r, init_count)
         popped_created_r = T_r - popped_init_r
         surv = create_ok & (crank >= popped_created_r) & has_mslot
-        pos = (init_count - popped_init_r) + (crank - popped_created_r)
+        # pos >= 0 on every lane etgt consumes: surv requires
+        # crank >= popped_created_r, and popped_init_r = min(T, init) <=
+        # init_count always; the max states that invariant for interval
+        # reasoning (non-surv lanes carry masked garbage either way)
+        pos = jnp.maximum(
+            (init_count - popped_init_r) + (crank - popped_created_r), 0
+        )
         etgt = (
             jnp.where(surv, glast, U32(b)),
             jnp.where(surv, mslot_idx, U32(k)),

@@ -88,7 +88,13 @@ def chacha_blocks(
         _qr(s, 1, 6, 11, 12)
         _qr(s, 2, 7, 8, 13)
         _qr(s, 3, 4, 9, 14)
-    return jnp.stack([a + b for a, b in zip(s, init)], axis=-1)
+    # feedforward (state + init, mod 2^32 by RFC 7539) as a plain loop:
+    # a listcomp would put the adds in a `<listcomp>` frame on py<=3.11,
+    # making the rangelint allowlist site key python-version-dependent
+    out = []
+    for a, b in zip(s, init):
+        out.append(a + b)
+    return jnp.stack(out, axis=-1)
 
 
 def row_keystream(

@@ -79,8 +79,17 @@ def argmin_u64_onehot(valid, hi, lo):
 
 
 def rank_of(mask):
-    """Exclusive prefix count of True lanes: rank[i] = #True among mask[:i]."""
-    return jnp.cumsum(mask.astype(jnp.int32)) - mask.astype(jnp.int32)
+    """Exclusive prefix count of True lanes: rank[i] = #True among mask[:i].
+
+    Computed as the shifted inclusive cumsum rather than
+    ``cumsum(m) - m``: identical values (exclusive prefix, always
+    >= 0), but interval-transparent — a non-relational domain
+    (analysis/rangelint.py) cannot see that a prefix sum dominates its
+    own last term, so the subtraction form reads as "can go to -1" and
+    poisons every downstream u32 cast."""
+    m = mask.astype(jnp.int32)
+    incl = jnp.cumsum(m)
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
 
 def u64_add_u32(lo, hi, k):
     """(lo, hi) + k with carry — u64 arithmetic in u32 lanes (x64 off)."""

@@ -87,12 +87,26 @@ def _rank_pass(digit: jax.Array, nbins: int) -> jax.Array:
     """
     b = digit.shape[0]
     iota = jnp.arange(b, dtype=I32)
+    # the max/min clamps below are runtime identities (each states an
+    # invariant of counting ranks: an exclusive prefix never exceeds its
+    # position, a permutation never exceeds B-1) written so a
+    # non-relational interval domain (analysis/rangelint.py) can carry
+    # the bound instead of widening to 2B — which would escape int32 at
+    # the 2^30 certified geometry
     if nbins == 2:
         # the 1-bit pass needs no bin table: two exclusive ranks
-        ones_before = jnp.cumsum(digit) - digit
-        zeros_before = iota - ones_before
-        n_zeros = b - (ones_before[-1] + digit[-1])
-        return jnp.where(digit == 1, n_zeros + ones_before, zeros_before)
+        incl = jnp.cumsum(digit)
+        ones_before = jnp.concatenate([jnp.zeros((1,), I32), incl[:-1]])
+        zeros_before = jnp.maximum(iota - ones_before, 0)
+        n_zeros = jnp.maximum(b - incl[-1], 0)
+        # n_zeros + ones_before <= B-1 truly (a stable partition is a
+        # permutation) but sums to 2B in interval arithmetic — escaping
+        # int32 at B = 2^30; the add rides RANGE_ALLOWLIST and the clip
+        # re-bounds the permutation for downstream (runtime identity)
+        return jnp.clip(
+            jnp.where(digit == 1, n_zeros + ones_before, zeros_before),
+            0, b - 1,
+        )
     # scatter-bincount one-hot (integer scatter — no [B, nbins] bool),
     # inclusive cumsum down the batch axis, then two gathers: the last
     # row is the per-bin total, the (j, digit[j]) entry the within-bin
@@ -101,10 +115,14 @@ def _rank_pass(digit: jax.Array, nbins: int) -> jax.Array:
         1, unique_indices=True
     )
     csum = jnp.cumsum(oh, axis=0)
-    within = jnp.take_along_axis(csum, digit[:, None], axis=1)[:, 0] - 1
+    within = jnp.maximum(
+        jnp.take_along_axis(csum, digit[:, None], axis=1)[:, 0] - 1, 0
+    )
     counts = csum[-1]
-    offs = jnp.cumsum(counts) - counts  # exclusive bin offsets
-    return offs[digit] + within
+    # exclusive bin offsets, as the shifted inclusive cumsum
+    binc = jnp.cumsum(counts)
+    offs = jnp.concatenate([jnp.zeros((1,), I32), binc[:-1]])
+    return jnp.minimum(offs[digit] + within, b - 1)
 
 
 def partition_rank(flags) -> jax.Array:

@@ -63,6 +63,60 @@ from ..obs.phases import device_phase
 
 U32 = jnp.uint32
 
+#: u32-lane certified geometry (rangelint, OPERATIONS.md §18): the
+#: largest tree this codebase's index arithmetic provably never wraps
+#: at. OramConfig.__post_init__ refuses anything bigger.
+MAX_U32_HEIGHT = 29
+MAX_U32_BLOCKS = 1 << 30
+
+
+def RANGELINT_BOUNDS(cfg: "OramConfig", prefix: str = "state") -> dict:
+    """Rangelint input-interval anchors (analysis/rangelint.py) for one
+    ``OramState`` pytree under ``prefix`` — the declared invariants of
+    the private planes where geometry-bounded values enter a traced
+    round:
+
+    - position values (flat table, stash/cache leaf metadata, the
+      recursive map's internal table and packed entry values) are
+      leaves: ``< cfg.leaves``;
+    - everything encrypted at rest (HBM tree planes under the cipher)
+      or sentinel-bearing (stash/cache idx) stays at the full u32 lane
+      — ciphertext is opaque to interval reasoning, and the round's own
+      clamps/masks re-establish bounds after decryption (the posmap
+      ``& (leaves-1)`` masks, the eviction bid clamp).
+
+    Declared bounds are *assumptions* the rest of the program is
+    certified against; each is an invariant an existing test pins."""
+    lv = cfg.leaves - 1
+    b = {
+        f"{prefix}.stash_leaf": (0, lv),
+        f"{prefix}.cache_leaf": (0, lv),
+        # sticky diagnostic counter with a declared per-run increment
+        # budget (2^16 ≫ any round's possible drops): the budgeted
+        # headroom is what certifies `overflow + dropped` wrap-free
+        f"{prefix}.overflow": (0, 2**32 - 2**16),
+    }
+    if not cfg.encrypted:
+        # plaintext trees carry their leaf metadata un-ciphered
+        b[f"{prefix}.tree_leaf"] = (0, lv)
+    if cfg.posmap is None:
+        b[f"{prefix}.posmap"] = (0, lv)
+    else:
+        from .posmap import inner_oram_config
+
+        icfg = inner_oram_config(cfg.posmap)
+        inner = f"{prefix}.posmap.inner"
+        # the internal ORAM's block values are packed OUTER leaf
+        # entries; its own flat map holds INTERNAL leaves
+        b[f"{inner}.posmap"] = (0, icfg.leaves - 1)
+        b[f"{inner}.stash_val"] = (0, lv)
+        b[f"{inner}.cache_val"] = (0, lv)
+        b[f"{inner}.overflow"] = (0, 2**32 - 2**16)
+        if not icfg.encrypted:
+            b[f"{inner}.tree_val"] = (0, lv)
+        b[f"{prefix}.posmap.dummy_entry"] = (0, lv)
+    return b
+
 
 def cipher_rows(
     cfg: "OramConfig",
@@ -160,6 +214,37 @@ class OramConfig:
             raise ValueError(
                 f"top_cache_levels must be in [0, height={self.height}] "
                 f"(at least the leaf level stays in the HBM tree), got {k}"
+            )
+        # rangelint certified-geometry guard (analysis/rangelint.py;
+        # tools/check_ranges.py cites this refusal in its report): every
+        # device lane is u32 and every gather/scatter index converts to
+        # int32 on the way into XLA, so the geometry must keep (a) heap
+        # bucket ids plus the bucket-axis OOB-drop sentinel
+        # (n_buckets_padded) within int32, (b) the leaf-plane cipher's
+        # domain-separation offset (bucket + n_buckets_padded) within
+        # u32, and (c) block ids plus the row-map sentinel (blocks + 2)
+        # within int32 and below SENTINEL. height <= 29 and blocks <=
+        # 2^30 certify all three with margin (the full argument is the
+        # certified-geometry table, OPERATIONS.md §18). Scaling past
+        # this bound is recipient-space sharding (ROADMAP item 2) or a
+        # deeper recursion with widened lanes (item 4) — never a silent
+        # wraparound.
+        if self.height > MAX_U32_HEIGHT:
+            raise ValueError(
+                f"height {self.height} exceeds the u32-lane certified "
+                f"bound (height <= {MAX_U32_HEIGHT}: heap bucket ids and "
+                "int32 index conversions wrap past it — rangelint "
+                "certified geometry, OPERATIONS.md §18); shard the "
+                "recipient space or widen the lanes instead"
+            )
+        if self.blocks > MAX_U32_BLOCKS:
+            raise ValueError(
+                f"blocks {self.blocks} exceeds the u32-lane certified "
+                f"bound (blocks <= {MAX_U32_BLOCKS} = 2^30: block ids, "
+                "the dummy index, and the row-map drop sentinel must fit "
+                "int32 below SENTINEL — rangelint certified geometry, "
+                "OPERATIONS.md §18); shard the recipient space or widen "
+                "the lanes instead"
             )
 
     @property
@@ -345,9 +430,13 @@ def _common_prefix_depth(cfg: OramConfig, leaves_a: jax.Array, leaf_b: jax.Array
     the path to ``leaf_b``: the length of the common prefix of the two
     height-bit leaf numbers. Exact integer computation, unrolled over the
     (static) height."""
+    # range argument (rangelint): the shifts stay in the u32 leaf lane
+    # (shift amounts are trace-time constants in [0, height-1]) and the
+    # int32 accumulator is bounded by height <= MAX_U32_HEIGHT — the
+    # depth never approaches either lane's ceiling.
     d = jnp.zeros(leaves_a.shape, jnp.int32)
     for j in range(1, cfg.height + 1):
-        shift = cfg.height - j
+        shift = U32(cfg.height - j)
         d = d + (leaves_a >> shift == leaf_b >> shift).astype(jnp.int32)
     return d  # in [0, height]
 
@@ -470,20 +559,25 @@ def oram_access(
     # tree-top cache split: levels [0, kc) live decrypted in the cache
     # planes; only the bottom plen−kc levels touch the encrypted HBM
     # tree (and pay cipher work). kc=0 degenerates to the full path.
+    # Slot-plane HBM addressing is bucket-axis ([n, Z] reshape views —
+    # free, layout-identical): flat slot ids (bucket·Z + slot) escape
+    # u32/int32 one geometry doubling before bucket ids do, so the
+    # certified bound rides the bucket axis (rangelint; OPERATIONS.md
+    # §18). The tiny cache planes keep flat slot addressing.
     kc = cfg.top_cache_levels
     bot_b = path_b[kc:]
-    bot_slots = path_slot_indices(cfg, bot_b).reshape(-1)
-    top_b = path_b[:kc]
+    # runtime identity: top-kc heap ids are < cache_buckets by level
+    # structure (see the matching clamp in round.py)
+    top_b = jnp.minimum(path_b[:kc], U32(max(cfg.cache_buckets, 1) - 1))
     top_slots = path_slot_indices(cfg, top_b).reshape(-1)
 
     # --- fetch path ∪ stash into the working set -----------------------
     with device_phase("oram_fetch"):
-        pidx = _path_gather(state.tree_idx, bot_slots, axis_name)
+        pidx = _path_gather(state.tree_idx.reshape(-1, z), bot_b, axis_name)
         pval = _path_gather(state.tree_val, bot_b, axis_name)
         pnonce = _path_gather(state.nonces, bot_b, axis_name)
         pidx, pval = cipher_rows(
-            cfg, state.cipher_key, bot_b, pnonce,
-            pidx.reshape(plen - kc, z), pval,
+            cfg, state.cipher_key, bot_b, pnonce, pidx, pval,
         )
         if kc:
             # cached top levels: plain private gathers (same standing as
@@ -493,10 +587,11 @@ def oram_access(
             )
             pval = jnp.concatenate([state.cache_val[top_b], pval], axis=0)
         if recursive:
-            pleaf = _path_gather(state.tree_leaf, bot_slots, axis_name)
+            pleaf = _path_gather(
+                state.tree_leaf.reshape(-1, z), bot_b, axis_name
+            )
             pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, bot_b, pnonce,
-                pleaf.reshape(plen - kc, z),
+                cfg, state.cipher_key, bot_b, pnonce, pleaf,
             )
             if kc:
                 pleaf = jnp.concatenate(
@@ -580,8 +675,9 @@ def oram_access(
         if recursive
         else state.stash_leaf
     )
-    stash_dropped = jnp.sum(leftover) - jnp.minimum(
-        jnp.sum(leftover), cfg.stash_size
+    # == n_left - min(n_left, stash_size), interval-transparent form
+    stash_dropped = jnp.maximum(
+        jnp.sum(leftover.astype(jnp.int32)) - cfg.stash_size, 0
     )
 
     overflow = (
@@ -624,8 +720,8 @@ def oram_access(
                 new_pleaf.reshape(plen, z)[kc:],
             )
             tree_leaf = _path_scatter(
-                state.tree_leaf, bot_slots, enc_pleaf.reshape(-1), axis_name
-            )
+                state.tree_leaf.reshape(-1, z), bot_b, enc_pleaf, axis_name
+            ).reshape(-1)
             if kc:
                 cache_leaf = state.cache_leaf.at[top_slots].set(
                     new_pleaf[: kc * z], unique_indices=True
@@ -634,8 +730,8 @@ def oram_access(
             tree_leaf = state.tree_leaf
     new_state = OramState(
         tree_idx=_path_scatter(
-            state.tree_idx, bot_slots, enc_pidx.reshape(-1), axis_name
-        ),
+            state.tree_idx.reshape(-1, z), bot_b, enc_pidx, axis_name
+        ).reshape(-1),
         tree_val=_path_scatter(state.tree_val, bot_b, enc_pval, axis_name),
         cache_idx=cache_idx,
         cache_val=cache_val,

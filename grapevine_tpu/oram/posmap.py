@@ -290,6 +290,42 @@ OBLINT_SECRETS = (
 )
 
 
+def RANGELINT_BOUNDS(cfg, prefix: str = "pm_state") -> dict:
+    """Rangelint input-interval anchors (analysis/rangelint.py) for one
+    ``lookup_remap_round`` / ``oram_round`` argument set at geometry
+    ``cfg``: queried indices are block ids or the dummy, every leaf
+    argument (remap targets, dummy fetches, internal-map remaps) is a
+    fresh uniform draw below its tree's leaf count, and the map state
+    itself carries the per-plane invariants of
+    :func:`path_oram.RANGELINT_BOUNDS`.  The k-entry packing offsets
+    (``idx >> lg k``, ``idx & (k-1)``, ``last_slot·k + off``) are then
+    *derived* clean from these bounds — the packing-offset audit the
+    satellite names."""
+    lv = cfg.leaves - 1
+    b = {
+        "idxs": (0, cfg.dummy_index),
+        "new_leaves": (0, lv),
+        "dummy_leaves": (0, lv),
+    }
+    # the map-state pytree: flat = the bare table; recursive = the
+    # RecursivePosMapState (inner OramState + dummy_entry)
+    if cfg.posmap is None:
+        b[prefix] = (0, lv)
+    else:
+        icfg = inner_oram_config(cfg.posmap)
+        il = icfg.leaves - 1
+        b["pm_new_leaves"] = (0, il)
+        b["pm_dummy_leaves"] = (0, il)
+        b[f"{prefix}.inner.posmap"] = (0, il)
+        b[f"{prefix}.inner.stash_val"] = (0, lv)
+        b[f"{prefix}.inner.cache_val"] = (0, lv)
+        b[f"{prefix}.inner.overflow"] = (0, 2**32 - 2**16)
+        if not icfg.encrypted:
+            b[f"{prefix}.inner.tree_val"] = (0, lv)
+        b[f"{prefix}.dummy_entry"] = (0, lv)
+    return b
+
+
 def lookup_remap_round(
     cfg,
     pm_state,
@@ -368,6 +404,12 @@ def lookup_remap_round(
             pm_dummy_leaves, apply_pm,
             occ_impl=occ_impl, sort_impl=sort_impl,
         )
+    # looked-up entries come out of the (decrypted) internal tree, which
+    # interval reasoning must treat as opaque; the mask re-establishes
+    # the `< leaves` invariant the entries were stored under (identity
+    # for honest state — leaves is a power of two — and defense in depth
+    # against corrupt ciphertext steering a path fetch out of range)
+    looked = looked & U32(cfg.leaves - 1)
     leaves = jnp.where(first_occ, looked, dummy_leaves)
     return pm_state._replace(inner=inner2), leaves, inner_leaves
 
@@ -407,6 +449,9 @@ def lookup_remap_one(cfg, pm_state, idx, new_leaf, pm_leaf=None):
         inner2, looked, inner_leaf = oram_access(
             icfg, pm_state.inner, inner_idx, pm_leaf, None, fn
         )
+    # same `< leaves` re-establishment as lookup_remap_round: decrypted
+    # internal-tree entries are opaque to interval reasoning
+    looked = looked & U32(cfg.leaves - 1)
     leaf = jnp.where(is_dummy, pm_state.dummy_entry, looked)
     dummy2 = jnp.where(is_dummy, new_leaf, pm_state.dummy_entry)
     return (

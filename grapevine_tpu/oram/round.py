@@ -245,11 +245,22 @@ def oram_round(
     # both shrink by kc/plen (the jaxpr audit in
     # tools/check_tree_cache_oblivious.py pins this). kc=0 degenerates
     # to the full-path program bit-for-bit.
+    # HBM slot planes are addressed on the bucket axis ([n, Z] reshape
+    # views — free, layout-identical): flat slot ids (bucket·Z + slot)
+    # escape u32/int32 one geometry doubling before bucket ids do, so
+    # the certified u32 bound rides the bucket axis (rangelint;
+    # OPERATIONS.md §18). The tiny cache planes keep flat addressing.
     kc = cfg.top_cache_levels
     nbot = plen - kc
     bot_b = path_b[:, kc:].reshape(b * nbot)
-    bot_slots = path_slot_indices(cfg, bot_b).reshape(-1)  # [B*nbot*z]
-    top_b = path_b[:, :kc].reshape(b * kc)
+    # level ℓ < kc heap ids are < 2^kc − 1 = cache_buckets by
+    # construction (path_bucket_indices level structure); the min
+    # states that per-level invariant, which a whole-array interval
+    # cannot carry through the column slice (runtime identity)
+    top_b = jnp.minimum(
+        path_b[:, :kc].reshape(b * kc),
+        U32(max(cfg.cache_buckets, 1) - 1),
+    )
     top_slots = path_slot_indices(cfg, top_b).reshape(-1)  # [B*kc*z]
 
     fused = cfg.cipher_impl in ("pallas_fused", "pallas_fused_tiled")
@@ -272,9 +283,9 @@ def oram_round(
                 interpret=jax.default_backend() not in _TPU_BACKENDS,
             )
         else:
-            pidx = _path_gather(state.tree_idx, bot_slots, axis_name).reshape(
-                b * nbot, z
-            )
+            pidx = _path_gather(
+                state.tree_idx.reshape(-1, z), bot_b, axis_name
+            )  # [B*nbot, z]
             pval = _path_gather(state.tree_val, bot_b, axis_name)  # [B*nbot, z*v]
             pnonce = _path_gather(state.nonces, bot_b, axis_name)
             pidx, pval = cipher_rows(
@@ -298,11 +309,12 @@ def oram_round(
             # the fused kernels cover only the idx/val planes
             from .path_oram import leaf_plane_cipher
 
-            pleaf = _path_gather(state.tree_leaf, bot_slots, axis_name)
+            pleaf = _path_gather(
+                state.tree_leaf.reshape(-1, z), bot_b, axis_name
+            )
             pnonce_l = _path_gather(state.nonces, bot_b, axis_name)
             pleaf = leaf_plane_cipher(
-                cfg, state.cipher_key, bot_b, pnonce_l,
-                pleaf.reshape(b * nbot, z),
+                cfg, state.cipher_key, bot_b, pnonce_l, pleaf,
             )
             if kc:
                 pleaf = jnp.concatenate(
@@ -400,9 +412,19 @@ def oram_round(
         iota_w = jnp.arange(w, dtype=jnp.int32)
         placed = jnp.zeros((w,), jnp.bool_)  # sorted order
         slot_tgt_s = jnp.full((w,), nslots, U32)  # sorted order; OOB = unplaced
+        # invalid rows carry the sort sentinel (0xFFFFFFFF / 2^h) in
+        # sleaf; clamp to the real leaf range BEFORE the heap-id
+        # arithmetic so `hb` provably fits u32 at every certified
+        # geometry (the unclamped sentinel wrapped hb mod 2^32 —
+        # harmless only because svalid masked those rows downstream;
+        # rangelint flags exactly that kind of masked wraparound).
+        # Clamped sentinel rows merge into the last real segment; they
+        # are a sorted suffix and never eligible, so real rows' segment
+        # starts and ranks are unchanged.
+        bleaf = jnp.minimum(sleaf, U32(cfg.leaves - 1))
         for level in range(h, -1, -1):
             shift = U32(h - level)
-            bid = sleaf >> shift  # bucket prefix per entry; sorted ⇒ contiguous
+            bid = bleaf >> shift  # bucket prefix per entry; sorted ⇒ contiguous
             hb = (U32(1) << U32(level)) - U32(1) + bid  # heap bucket index
             # one gather answers both "was my bucket fetched" (owner != B)
             # and "which column's output rows hold it"
@@ -412,9 +434,16 @@ def oram_round(
             )
             elig = svalid & ~placed & (oc != U32(b))
             ei = elig.astype(jnp.int32)
-            ecum = jnp.cumsum(ei) - ei  # exclusive count of eligibles
+            # exclusive count of eligibles, as the shifted inclusive
+            # cumsum (interval-transparent, see primitives.rank_of)
+            ecum = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(ei)[:-1]]
+            )
             start = jax.lax.cummax(jnp.where(bnd, iota_w, 0))  # my segment start
-            rank = ecum - ecum[start]  # exclusive rank within my bucket
+            # exclusive rank within my bucket: >= 0 because ecum is
+            # monotone and start[i] <= i; the max states that invariant
+            # for interval reasoning (identity at runtime)
+            rank = jnp.maximum(ecum - ecum[start], 0)
             chosen = elig & (rank < z)
             slot = (oc * U32(plen) + U32(level)) * U32(z) + rank.astype(U32)
             slot_tgt_s = jnp.where(chosen, slot, slot_tgt_s)
@@ -458,14 +487,14 @@ def oram_round(
             else state.stash_leaf
         )
         n_left = jnp.sum(leftover.astype(jnp.int32))
-        stash_dropped = (n_left - jnp.minimum(n_left, s)).astype(U32)
+        # == n_left - min(n_left, s), in the interval-transparent form
+        stash_dropped = jnp.maximum(n_left - s, 0).astype(U32)
 
-    # owner expansion for the flat slot axis: each of a bucket's z slots
-    # shares the bucket's owner bit; the eviction output new_pidx/new_pval
-    # is [col, level, slot]-ordered, so the top-kc/bottom split is a
-    # contiguous reshape per column
+    # the eviction output new_pidx/new_pval is [col, level, slot]-
+    # ordered, so the top-kc/bottom split is a contiguous reshape per
+    # column; one owner bit per bucket row covers all z slots on the
+    # bucket-axis scatters below
     fowner_bot = fowner.reshape(b, plen)[:, kc:].reshape(b * nbot)
-    fowner_bot_slots = jnp.repeat(fowner_bot, z)
     bot_pidx = new_pidx.reshape(b, plen, z)[:, kc:].reshape(b * nbot, z)
     bot_pval = new_pval.reshape(b, plen, z * v)[:, kc:].reshape(
         b * nbot, z * v
@@ -502,9 +531,9 @@ def oram_round(
                 bot_pval,
             )
             tree_idx_new = _path_scatter(
-                state.tree_idx, bot_slots, enc_pidx.reshape(-1), axis_name,
-                fowner_bot_slots,
-            )
+                state.tree_idx.reshape(-1, z), bot_b, enc_pidx, axis_name,
+                fowner_bot,
+            ).reshape(-1)
             tree_val_new = _path_scatter(
                 state.tree_val, bot_b, enc_pval, axis_name, fowner_bot
             )
@@ -547,9 +576,9 @@ def oram_round(
                 pleaf3[:, kc:].reshape(b * nbot, z),
             )
             tree_leaf_new = _path_scatter(
-                state.tree_leaf, bot_slots, enc_pleaf.reshape(-1), axis_name,
-                fowner_bot_slots,
-            )
+                state.tree_leaf.reshape(-1, z), bot_b, enc_pleaf, axis_name,
+                fowner_bot,
+            ).reshape(-1)
             if kc:
                 cache_leaf_new = _path_scatter(
                     state.cache_leaf, top_slots,

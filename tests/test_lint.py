@@ -1,13 +1,14 @@
-"""Tier-1 lint gate (ISSUE 12 satellite): ruff's pyflakes/import tier.
+"""Tier-1 lint gate (ISSUE 12 satellite; tier promoted in ISSUE 14).
 
 The pinned config lives in pyproject.toml (``[tool.ruff]``, select
 E4/E7/E9/F — imports and real errors only, no formatting churn). Where
 the ruff binary exists (dev machines, CI images with the wheel) the
 gate runs it verbatim; this container bakes its dependencies and ships
-no ruff, so the gate falls back to the stdlib AST unused-import check
-(grapevine_tpu/analysis/importlint.py — the F401+E9 subset, polarity
-chosen to never false-positive). Either way the suite fails on a real
-finding; nothing is installed at test time.
+no ruff, so the gate falls back to the stdlib AST checker
+(grapevine_tpu/analysis/importlint.py — F401 unused imports, F841
+unused locals, E722 bare excepts, E9 syntax; polarity chosen to never
+false-positive). Either way the suite fails on a real finding; nothing
+is installed at test time.
 """
 
 from __future__ import annotations
@@ -52,6 +53,58 @@ def test_importlint_detects_seeded_finding():
     assert check_source("import os  # noqa: F401\n") == []
     # syntax errors surface instead of passing silently (the E9 subset)
     assert check_source("def broken(:\n")[0][1] == "<syntax>"
+
+
+def test_importlint_f841_unused_local():
+    """The ISSUE-14 tier promotion: F841 with conservative scoping."""
+    from grapevine_tpu.analysis.importlint import check_source
+
+    flagged = check_source(
+        "def f():\n    x = compute()\n    return 1\n"
+    )
+    assert [(n) for _, n, _ in flagged] == ["x"]
+    # used, underscore, closure-read, and noqa'd bindings stay clean
+    assert check_source("def f():\n    x = 1\n    return x\n") == []
+    assert check_source("def f():\n    _scratch = 1\n    return 2\n") == []
+    assert check_source(
+        "def f():\n    x = 1\n    def g():\n        return x\n"
+        "    return g\n"
+    ) == []
+    assert check_source(
+        "def f():\n    x = compute()  # noqa: F841\n    return 1\n"
+    ) == []
+    # dynamic scopes (locals/eval) disable the check for that function
+    assert check_source(
+        "def f():\n    x = 1\n    return locals()\n"
+    ) == []
+    # an augmented assignment READS the prior binding — never flagged
+    # (review finding: `x = 0; x += 1` must not suggest deleting x = 0)
+    assert check_source(
+        "def f():\n    x = 0\n    x += 1\n    return 2\n"
+    ) == []
+    # `except ... as e` with an unread name is the other F841 shape
+    flagged = check_source(
+        "def f():\n    try:\n        g()\n"
+        "    except ValueError as exc:\n        pass\n"
+    )
+    assert [(n) for _, n, _ in flagged] == ["exc"]
+
+
+def test_importlint_e722_bare_except():
+    from grapevine_tpu.analysis.importlint import check_source
+
+    flagged = check_source(
+        "def f():\n    try:\n        g()\n    except:\n        pass\n"
+    )
+    assert [(n) for _, n, _ in flagged] == ["<bare-except>"]
+    assert check_source(
+        "def f():\n    try:\n        g()\n"
+        "    except Exception:\n        pass\n"
+    ) == []
+    assert check_source(
+        "def f():\n    try:\n        g()\n"
+        "    except:  # noqa: E722\n        pass\n"
+    ) == []
 
 
 def test_fallback_matches_package_clean_state():

@@ -499,9 +499,12 @@ def test_k0_recursive_census_cell():
     """Regression (ISSUE 12 satellite): the k=0 recursive cell the
     pre-unification wiring never ran always-on — the uncached recursive
     round must be index-blind and move full B*path_len rows per plane,
-    tree_leaf included, with no cache planes declared."""
+    tree_leaf included, with no cache planes declared. height=5 keeps
+    the bucket-axis [n, Z] plane shapes disjoint from the inner posmap
+    round's working buffers (the shape-keyed accounting's one
+    constraint, see _tree_planes)."""
     import check_tree_cache_oblivious as cache_gate
 
-    out = cache_gate.check_k0_recursive_census(b=4, height=4)
-    assert out["tree_leaf"] == [4 * 5]  # B * (height+1)
+    out = cache_gate.check_k0_recursive_census(b=4, height=5)
+    assert out["tree_leaf"] == [4 * 6]  # B * (height+1)
     assert "cache_idx" not in out

@@ -118,7 +118,7 @@ def test_channel_handshake_and_framing():
         server2.decrypt(bytes(bad))
 
     # out-of-order (nonce desync) fails: a skipped frame breaks the stream
-    c3 = client_chan.encrypt(m1)
+    client_chan.encrypt(m1)  # c3: sent but never delivered
     c4 = client_chan.encrypt(m2)
     with pytest.raises(Exception):
         server_chan.decrypt(c4)  # expects c3 first

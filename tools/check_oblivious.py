@@ -21,11 +21,15 @@ default; the full 2x2x2x2 cross-product under ``--full`` (the -m slow
 tier). ``--smoke`` is the tier-1 budget: one representative combo, one
 engine trace, no compile.
 
-Teeth: the seeded leaky mutants (grapevine_tpu/analysis/mutants.py) run
-under the SAME allowlist on every invocation and must each FAIL —
-position-dependent branch, key-indexed gather, data-dependent early
-exit, secret-shaped output, un-allowlisted scatter, leaky debug print,
-python-level branch. A passing mutant fails this gate.
+Teeth: the seeded mutants (grapevine_tpu/analysis/mutants.py) run under
+the production allowlists on every invocation and must each FAIL — the
+seven leak classes (position-dependent branch, key-indexed gather,
+data-dependent early exit, secret-shaped output, un-allowlisted
+scatter, leaky debug print, python-level branch) AND, since ISSUE 14,
+the five overflow classes through the rangelint sibling analyzer (one
+shared runner proves both analyzers alive from this one tier-1 gate;
+tools/check_ranges.py is the overflow analyzer's own driver). A
+passing mutant fails this gate.
 
 The host prong: grapevine_tpu/analysis/locklint.py statically asserts
 the PR-10 pipeline discipline (journal+dispatch in exactly one engine
@@ -289,19 +293,23 @@ def census_equal_engine(ecfg, name: str):
 
 
 def run_mutant_controls(allowlist) -> list:
-    """Every seeded mutant must FAIL under the production allowlist."""
-    from grapevine_tpu.analysis.mutants import run_mutants
+    """Every seeded mutant must FAIL under the production allowlists.
 
-    failures = []
-    for name, (rep, kind, hit) in run_mutants(allowlist).items():
-        status = "FAIL (expected)" if hit else "PASSED — NO TEETH"
-        print(f"[check_oblivious] mutant {name}: {status}")
-        if not hit:
-            failures.append(
-                f"mutant {name!r} was NOT caught (expected a {kind} "
-                f"violation; got {[v.kind for v in rep.violations]})"
-            )
-    return failures
+    One shared runner for BOTH analyzers (ISSUE 14): the oblint leak
+    mutants under the taint allowlist and the rangelint overflow mutants
+    under the range allowlist — a single tier-1 gate proves both
+    analyzers still have teeth."""
+    from grapevine_tpu.analysis.allowlist import RANGE_ALLOWLIST
+    from grapevine_tpu.analysis.mutants import (
+        control_failures, run_mutants, run_range_mutants,
+    )
+
+    log = lambda line: print(f"[check_oblivious] {line}")  # noqa: E731
+    return control_failures(
+        run_mutants(allowlist), "mutant", log
+    ) + control_failures(
+        run_range_mutants(RANGE_ALLOWLIST), "range mutant", log
+    )
 
 
 def run_locklint() -> list:

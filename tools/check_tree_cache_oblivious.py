@@ -100,13 +100,17 @@ def _tree_planes(cfg) -> dict:
     z, v = cfg.bucket_slots, cfg.value_words
     n = cfg.n_buckets_padded
     cb = cfg.cache_buckets
+    # tree_idx/tree_leaf are stored flat [n·Z] but fetched/written
+    # through bucket-axis [n, Z] reshape views since ISSUE 14 (the u32
+    # certified-geometry refactor), so the gather/scatter operands the
+    # accounting matches on are the 2-D views at divisor 1
     planes = {
-        "tree_idx": ((n * z,), z),
+        "tree_idx": ((n, z), 1),
         "tree_val": ((n, z * v), 1),
         "nonces": ((n, 2), 1),
     }
     if cfg.posmap is not None:
-        planes["tree_leaf"] = ((n * z,), z)
+        planes["tree_leaf"] = ((n, z), 1)
     if cb:
         planes["cache_idx"] = ((cb * z,), z)
         planes["cache_val"] = ((cb, z * v), 1)
@@ -206,7 +210,7 @@ def check_tree_cache_schedule(
     return out
 
 
-def check_k0_recursive_census(b: int = 4, height: int = 4) -> dict:
+def check_k0_recursive_census(b: int = 4, height: int = 5) -> dict:
     """The matrix cell the pre-ISSUE-12 wiring missed: ``k=0`` with
     ``posmap_impl=recursive``.
 
@@ -269,7 +273,7 @@ def main(argv=None) -> int:
             recursive=recursive,
         )
         print(f"[check_tree_cache_oblivious] recursive={recursive}: OK {out}")
-    out = check_k0_recursive_census(b=4, height=4)
+    out = check_k0_recursive_census(b=4, height=5)
     print(f"[check_tree_cache_oblivious] k0-recursive cell: OK {out}")
     print("[check_tree_cache_oblivious] PASS: cached round is index-blind "
           "and HBM path traffic is exactly B·(path_len−k) rows per plane")
