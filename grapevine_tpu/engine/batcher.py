@@ -54,7 +54,7 @@ from .state import (
     init_engine,
 )
 from .metrics import EngineMetrics
-from .round_step import engine_round_step
+from .round_step import engine_flush_step, engine_round_step
 from .step import engine_step
 
 
@@ -269,6 +269,27 @@ class GrapevineEngine:
         self._sweep = jax.jit(
             expiry_sweep, static_argnums=(0,), donate_argnums=(1,)
         )
+        #: delayed batched eviction (PR 15, config.py evict_every): the
+        #: resolved cadence E and the jitted flush program. Flush fires
+        #: strictly every E dispatched rounds — a pure function of the
+        #: round counter, never of buffer contents or op mix (the
+        #: schedule-independence claim CI pins) — inside the same lock
+        #: hold as the E-th round, journaled (KIND_FLUSH) before it
+        #: dispatches like everything else. The counter itself is
+        #: recovered from state (rec.ebuf_rounds) so a crash can never
+        #: desynchronize cadence from content.
+        self.evict_every = self.ecfg.evict_every
+        self._flush_step = (
+            jax.jit(engine_flush_step, static_argnums=(0,),
+                    donate_argnums=(1,))
+            if self.evict_every > 1
+            else None
+        )
+        self._rounds_since_flush = 0
+        #: replay-time cadence audit (see _replay_record): rounds seen
+        #: since the last KIND_FLUSH record; None until the first
+        #: replayed record initializes it from the recovered state
+        self._replay_since: int | None = None
         self._lock = threading.Lock()
         #: resolved round-pipeline depth: the max dispatched-but-
         #: unresolved rounds a driver keeps in flight (config.py knob;
@@ -293,6 +314,8 @@ class GrapevineEngine:
                 2 if jax.default_backend() in TPU_BACKENDS else 1
             )
         self.metrics = EngineMetrics()
+        #: last sampled per-tree eviction-buffer occupancy (health view)
+        self._ebuf_counts: dict = {}
         #: streaming obliviousness auditor (obs/leakmon.py), attached by
         #: the serving layer when --leakmon is on; None = no monitoring
         self.leakmon = None
@@ -322,20 +345,116 @@ class GrapevineEngine:
                     self.state, self._replay_record
                 )
                 jax.block_until_ready(self.state.free_top)
+        if self.evict_every > 1:
+            # cadence counter recovered FROM STATE, never from a host
+            # mirror: the records tree runs exactly one fetch round per
+            # engine round, so its window counter IS rounds-since-flush
+            self._rounds_since_flush = int(self.state.rec.ebuf_rounds)
+            if self._rounds_since_flush >= self.evict_every:
+                # a crash landed between the E-th round's journal frame
+                # and its flush frame — complete the pending flush NOW
+                # (journaled), so the replayed journal keeps the exact
+                # [round_E, flush] adjacency an uninterrupted run writes
+                # and recovered placement stays bit-identical to it
+                with self._lock:
+                    self._flush_window_locked(min_rounds=self.evict_every)
+                jax.block_until_ready(self.state.free_top)
 
     def _replay_record(self, state: EngineState, rec) -> EngineState:
         """Apply one journal record through the same jitted programs the
         live path uses — replay IS re-execution, so recovered state is
-        bit-identical by the engine's own determinism."""
-        from .journal import KIND_ROUND
+        bit-identical by the engine's own determinism.
 
+        Cadence audit: the journal frames validate batch geometry but
+        not the eviction cadence (the checkpoint fingerprint covers E;
+        a journal-only recovery would not), so replay cross-checks it —
+        a KIND_FLUSH record on an evict_every=1 engine, or more rounds
+        than one window between flush records on an E>1 engine, means
+        the journal was written under a DIFFERENT cadence and silently
+        replaying it would corrupt the window ledger. Raise instead."""
+        from .journal import JournalError, KIND_FLUSH, KIND_ROUND
+
+        if self._flush_step is not None and self._replay_since is None:
+            # one device read at replay start: the recovered base
+            # state's window position anchors the cadence count
+            self._replay_since = int(state.rec.ebuf_rounds)
         if rec.kind == KIND_ROUND:
+            if self._flush_step is not None:
+                self._replay_since += 1
+                if self._replay_since > self.evict_every:
+                    raise JournalError(
+                        f"journal frame {rec.seq}: {self._replay_since} "
+                        f"rounds since the last flush record but this "
+                        f"engine flushes every {self.evict_every} — the "
+                        "journal was written under a different "
+                        "evict_every; replay requires the identical "
+                        "cadence"
+                    )
             state, _resp, _transcript = self._step(self.ecfg, state, rec.batch)
             return state
+        if rec.kind == KIND_FLUSH:
+            if self._flush_step is None:
+                raise JournalError(
+                    f"journal frame {rec.seq}: delayed-eviction flush "
+                    "record but this engine runs evict_every=1 — replay "
+                    "requires the cadence the journal was written under"
+                )
+            self._replay_since = 0
+            return self._flush_step(self.ecfg, state)
         return self._sweep(
             self.ecfg, state,
             np.uint32(rec.now), np.uint32(rec.period), np.uint32(rec.now_hi),
         )
+
+    # -- delayed batched eviction (PR 15; oram/round.py:oram_flush) -----
+
+    def _flush_window_locked(self, count_round: bool = False,
+                             min_rounds: int = 1) -> bool:
+        """Journal + dispatch one flush when the window is due; caller
+        holds the engine lock (every call site sits directly in a lock
+        region — analysis/locklint.py verifies it statically).
+
+        ``count_round=True`` counts one dispatched round first and
+        flushes only when the window closes (the steady-state cadence —
+        a pure function of the round counter, never of buffer
+        contents); ``count_round=False`` flushes iff at least
+        ``min_rounds`` rounds are buffered (recovery completion passes
+        ``min_rounds=evict_every`` so a crash mid-window never flushes
+        early; ``flush_now`` passes 1). The async dispatch is the
+        point: the flush rides the device queue behind the window's
+        last round, filling the idle window the bubble-ratio gauge
+        prices (tools/tpu_capture.py ``evict_perf`` banks the on-chip
+        overlap number) — the ``flush`` phase series measures enqueue
+        cost; device time lands in the next round's ``evict`` wait
+        like all device work."""
+        if self._flush_step is None:
+            return False
+        if count_round:
+            self._rounds_since_flush += 1
+        due = self.evict_every if count_round else max(1, min_rounds)
+        if self._rounds_since_flush < due:
+            return False
+        if self.durability is not None:
+            with self.metrics.time_phase("journal"):
+                self.durability.append_flush()
+        if faults.active():
+            # the kill-at-flush window: the flush frame is durable but
+            # the flush itself has not dispatched
+            faults.crash("flush.pre_dispatch")
+        with self.metrics.time_phase("flush"):
+            self.state = self._flush_step(self.ecfg, self.state)
+        if faults.active():
+            faults.crash("flush.post_dispatch")
+        self._rounds_since_flush = 0
+        return True
+
+    def flush_now(self) -> bool:
+        """Operator/test hook: flush a partial window immediately
+        (journaled). Returns False when delayed eviction is off or the
+        window is empty. NOT part of the steady-state cadence — the
+        schedule-independence claim is about the automatic trigger."""
+        with self._lock:
+            return self._flush_window_locked()
 
     def checkpoint_now(self) -> int | None:
         """Force a sealed checkpoint of the current state (the drain
@@ -688,6 +807,16 @@ class GrapevineEngine:
                 t0, resp, transcript = self._dispatch_round(batch)
             if faults.active():
                 faults.crash("round.post_dispatch")
+            # delayed eviction: the E-th round's flush journals and
+            # dispatches in this same hold — the flush enqueues behind
+            # the round on the device and resolves inside the next
+            # round's evict wait (the overlap window). The span lands
+            # on THIS round's ledger (the window-closing round), so the
+            # tracer and flight recorder show which rounds paid a flush
+            # enqueue — the cadence is public (a pure round count)
+            t_f0 = time.perf_counter()
+            if self._flush_window_locked(count_round=True):
+                spans["flush"] = (t_f0, time.perf_counter() - t_f0)
             if self.durability is not None and self.durability.should_checkpoint():
                 # blocks this round's slot until the sealed state is on
                 # disk — the RTO/RPO trade --checkpoint-every-rounds
@@ -727,7 +856,9 @@ class GrapevineEngine:
             if self.durability is not None:  # same contract as the async path
                 self.durability.append_round(batch, len(reqs))
             self.state, resp, transcript = self._step(self.ecfg, self.state, batch)
-            return unpack_responses(resp, len(reqs)), np.asarray(transcript)
+            out = unpack_responses(resp, len(reqs)), np.asarray(transcript)
+            self._flush_window_locked(count_round=True)
+            return out
 
     def expire(self, now: int, period: int | None = None) -> int:
         """Run the expiry sweep; returns the number of records evicted."""
@@ -785,7 +916,7 @@ class GrapevineEngine:
         reduction every round would serialize the dispatch pipeline for
         a gauge that is only read between scrapes (it is also the
         /metrics endpoint's pre-scrape refresh hook, obs/httpd.py)."""
-        from ..oram.path_oram import stash_occupancy
+        from ..oram.path_oram import evict_buffer_occupancy, stash_occupancy
 
         with self._lock:
             state = self.state
@@ -801,8 +932,23 @@ class GrapevineEngine:
                 name: int(stash_occupancy(tree))
                 for name, tree in trees.items()
             }
+            ebuf = (
+                {
+                    name: int(evict_buffer_occupancy(tree))
+                    for name, tree in trees.items()
+                }
+                if self.evict_every > 1
+                else {}
+            )
+            self._ebuf_counts = ebuf
         for n in counts.values():
             self.metrics.observe_stash(n)
+        if ebuf:
+            # the buffer-occupancy canary (grapevine_evict_buffer_*):
+            # summed over trees at scrape cadence, high-water kept —
+            # approaching evict_buffer_slots means the sizing theory is
+            # being violated before overflow ever fires
+            self.metrics.observe_evict_buffer(sum(ebuf.values()))
         return counts
 
     def health(self) -> dict:
@@ -821,10 +967,26 @@ class GrapevineEngine:
                 # payload stash loss
                 overflow += int(state.rec.posmap.inner.overflow)
                 overflow += int(state.mb.posmap.inner.overflow)
-            return {
+            out = {
                 "messages": self.ecfg.max_messages - int(state.free_top),
                 "recipients": int(state.recipients),
                 "stash_overflow": overflow,
                 "stash_occupancy": occupancy,
                 **self.metrics.snapshot(),
             }
+            if self.evict_every > 1:
+                # delayed-eviction canary: per-tree buffer occupancy
+                # (sampled by sample_stash above) + capacity, so an
+                # operator sees near-overflow pressure before the shared
+                # sticky overflow counter ever fires. Buffer overflow
+                # rides stash_overflow — the buffer has the stash's
+                # standing, and a drop is a drop.
+                out["evict_buffer_occupancy"] = dict(
+                    getattr(self, "_ebuf_counts", {})
+                )
+                out["evict_buffer_slots"] = {
+                    "rec": self.ecfg.rec.evict_buffer_slots,
+                    "mb": self.ecfg.mb.evict_buffer_slots,
+                }
+                out["evict_rounds_since_flush"] = self._rounds_since_flush
+            return out

@@ -514,6 +514,14 @@ class DurabilityManager:
             self._c_records.inc()
         return seq
 
+    def append_flush(self) -> int:
+        """Delayed-eviction flush marker (engine/journal.py KIND_FLUSH);
+        counts toward the checkpoint cadence like rounds and sweeps."""
+        seq = self.journal.append_flush()
+        if self._c_records is not None:
+            self._c_records.inc()
+        return seq
+
     def should_checkpoint(self) -> bool:
         return (
             self.journal.seq - self.ckpt_seq

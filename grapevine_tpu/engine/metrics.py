@@ -92,6 +92,16 @@ class EngineMetrics:
             "grapevine_stash_high_water",
             "max sampled ORAM stash occupancy (must stay far below "
             "stash_size; overflow means the eviction invariant broke)")
+        self._g_ebuf = r.gauge(
+            "grapevine_evict_buffer_occupancy",
+            "sampled delayed-eviction buffer occupancy, summed over "
+            "trees (rows; batch-level — the buffer holds whole fetched "
+            "paths, never per-client state); 0 with evict_every=1")
+        self._g_ebuf_hw = r.gauge(
+            "grapevine_evict_buffer_high_water",
+            "max sampled delayed-eviction buffer occupancy (the "
+            "near-overflow canary: approaching evict_buffer_slots "
+            "means the window is undersized — OPERATIONS.md §19)")
         self._h_phase = r.histogram(
             "grapevine_phase_seconds",
             "wall time per round phase (batch-level; obs/phases.py)",
@@ -131,6 +141,12 @@ class EngineMetrics:
     def observe_stash(self, occupancy: int) -> None:
         self._g_stash_hw.set_max(occupancy)
         self._h_stash.observe(occupancy)
+
+    def observe_evict_buffer(self, occupancy: int) -> None:
+        """Sampled delayed-eviction buffer occupancy (rows, summed over
+        trees) — scrape-cadence like the stash gauge, never per round."""
+        self._g_ebuf.set(occupancy)
+        self._g_ebuf_hw.set_max(occupancy)
 
     def observe_phase(self, phase: str, seconds: float) -> None:
         self._h_phase.observe(seconds, phase=phase)

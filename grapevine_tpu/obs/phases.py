@@ -57,7 +57,7 @@ import time
 #: canonical phase label values — the registry declares exactly these,
 #: so a typo'd phase name raises instead of minting a new series
 PHASES = ("assembly", "verify", "dispatch", "evict", "demux", "sweep",
-          "journal", "checkpoint", "replay", "sort", "posmap")
+          "journal", "checkpoint", "replay", "sort", "posmap", "flush")
 
 #: fixed histogram boundaries for phase durations (seconds). Spans the
 #: measured range: ~100 µs host phases at B=8 up to multi-second expiry

@@ -77,6 +77,21 @@ def _oram_specs() -> OramState:
         stash_idx=P(),
         stash_val=P(),
         stash_leaf=P(),
+        # delayed-eviction buffer + window bookkeeping (PR 15): would be
+        # replicated private state with the stash's standing, but the
+        # sharded path currently supports evict_every=1 ONLY — there is
+        # no sharded flush program yet (engine_flush_step/oram_flush
+        # take no axis_name; composing the deduplicated flush targets
+        # with bucket-axis sharding is the ROADMAP item-1∘2 follow-up),
+        # so make_sharded_step rejects delayed-eviction geometries and
+        # these specs only ever carry the zero-length E=1 planes
+        ebuf_idx=P(),
+        ebuf_val=P(),
+        ebuf_leaf=P(),
+        ebuf_paths=P(),
+        ebuf_rounds=P(),
+        ebuf_gen=P(),
+        fetch_tag=P(),
         # flat: one replicated array. Recursive: a RecursivePosMapState
         # pytree — the P() prefix replicates the whole internal ORAM
         # (its own bucket tree included; sharding the *inner* tree along
@@ -146,6 +161,18 @@ def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     analog of the reference's SGX_MODE=SW simulation testing, reference
     .github/workflows/ci.yaml:15-16).
     """
+    if ecfg.evict_every > 1:
+        # no sharded flush program exists yet: a shard_map'd
+        # engine_flush_step would scatter the full deduplicated target
+        # set into every local shard unmasked (oram_flush is
+        # axis_name-less), corrupting the trees — refuse loudly instead
+        # of accumulating windows that can never drain (the item-1∘2
+        # composition is on the ROADMAP)
+        raise ValueError(
+            "delayed batched eviction (evict_every > 1) is not "
+            "supported on the sharded path yet — use evict_every=1 "
+            "with make_sharded_step"
+        )
     specs = engine_state_specs()
     step = _shard_map(
         functools.partial(engine_round_step, ecfg, axis_name=TREE_AXIS),

@@ -105,6 +105,32 @@ def build_parser() -> argparse.ArgumentParser:
         "auto: 2 on TPU backends, 1 elsewhere. Device-owning roles "
         "only — the frontend has no round pipeline",
     )
+    p.add_argument(
+        "--evict-every",
+        type=int,
+        default=None,
+        help="delayed batched eviction cadence E (oram/round.py, "
+        "OPERATIONS.md §19): fetched path contents accumulate in a "
+        "bounded private buffer and the scatter+encrypt half of the "
+        "round runs ONCE per E rounds over the window's deduplicated "
+        "bucket union — the steady-state round is gather+decrypt+"
+        "stash-update only. Responses and logical state are "
+        "bit-identical at every E; the flush cadence is a pure round "
+        "count, never buffer contents (CI-audited). 1 = per-round "
+        "eviction, bit for bit; unset = auto (currently 1 — "
+        "tools/tpu_capture.py evict_perf settles the on-chip flip). "
+        "Device-owning roles only",
+    )
+    p.add_argument(
+        "--evict-buffer-slots",
+        type=int,
+        default=None,
+        help="eviction-buffer capacity override (rows per payload "
+        "tree) under --evict-every > 1; unset = auto sizing "
+        "(OPERATIONS.md §19 — min(blocks, 2·Z·window·fetches + "
+        "slack)). Watch grapevine_evict_buffer_high_water before "
+        "lowering it. Device-owning roles only",
+    )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument(
         "--identity-seed",
@@ -298,10 +324,11 @@ _TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
 
 #: device-engine geometry/execution knobs: only roles that build an
 #: engine take them — a frontend supplying --posmap-impl,
-#: --tree-top-cache-levels, or --pipeline-depth would silently
-#: configure nothing (its engine lives in another process)
+#: --tree-top-cache-levels, --pipeline-depth, or --evict-every would
+#: silently configure nothing (its engine lives in another process)
 _ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels",
-                      "pipeline_depth"}
+                      "pipeline_depth", "evict_every",
+                      "evict_buffer_slots"}
 
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
@@ -427,6 +454,8 @@ def main(argv=None) -> int:
         posmap_impl=args.posmap_impl,
         tree_top_cache_levels=args.tree_top_cache_levels,
         pipeline_depth=args.pipeline_depth,
+        evict_every=args.evict_every,
+        evict_buffer_slots=args.evict_buffer_slots,
     )
     identity = None
     if args.identity_seed:

@@ -51,6 +51,31 @@ def test_randomized_kill_trials_recover_bit_identical():
     assert not failures, "\n".join(failures)
 
 
+def test_delayed_eviction_kill_trials_recover_bit_identical():
+    """ISSUE-15 chaos coverage: at ``--evict-every 4`` every fault site
+    runs again — mid-accumulation kills (``round.*``/``append.*``
+    landing with a part-filled eviction buffer and window ledger) AND
+    the flush-boundary windows (``flush.pre_dispatch`` with the flush
+    frame durable but undispatched, ``flush.post_dispatch`` before any
+    later frame), plus a randomized timer kill. Each trial is
+    multi-incarnation by construction (chaos_run relaunches until the
+    schedule completes, re-killing when the trigger re-arms), and every
+    incarnation's response hashes plus the final state must match the
+    uninterrupted E=4 oracle, with leakmon PASS on the recovered
+    engine — the buffer's stash-grade durability claim, end to end."""
+    chaos = _load_chaos()
+    from grapevine_tpu.testing.faults import ALL_POINTS
+
+    args = chaos.parse_args(
+        ["--events", "16", "--evict-every", "4", "--seed", "52",
+         "--checkpoint-every", "5"]
+    )
+    failures = chaos.run_trials(
+        0, args, modes=list(ALL_POINTS) + ["timer"]
+    )
+    assert not failures, "\n".join(failures)
+
+
 def test_pipelined_kill_trials_recover_bit_identical():
     """PR-10 chaos coverage: ``--pipeline-depth 2`` keeps a round
     mid-flight on the device while the next one journals + fsyncs, and

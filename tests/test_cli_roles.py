@@ -41,6 +41,12 @@ def _check(argv):
     # satellite) — rejected even at the explicit serial value
     ["--role", "frontend", "--pipeline-depth", "2"],
     ["--role", "frontend", "--pipeline-depth", "1"],
+    # eviction deferral is engine geometry (ISSUE 15 satellite) — a
+    # frontend supplying it would silently defer nothing; rejected even
+    # at the explicit per-round value, and the buffer override with it
+    ["--role", "frontend", "--evict-every", "4"],
+    ["--role", "frontend", "--evict-every", "1"],
+    ["--role", "frontend", "--evict-buffer-slots", "4096"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -79,6 +85,12 @@ def test_misapplied_flags_rejected(argv):
     ["--role", "mono", "--pipeline-depth", "2"],
     ["--role", "engine", "--engine-listen", "127.0.0.1:0",
      "--pipeline-depth", "1"],
+    # …and the eviction-deferral cadence + buffer override (ISSUE 15)
+    ["--role", "mono", "--evict-every", "4"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--evict-every", "1"],
+    ["--role", "mono", "--evict-every", "4",
+     "--evict-buffer-slots", "4096"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

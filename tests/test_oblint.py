@@ -255,15 +255,22 @@ def test_check_oblivious_smoke_gate():
 def test_engine_round_audit_is_violation_free_and_uses_allowlist():
     import check_oblivious as gate
 
-    vp, srt, pmi, k = gate.SMOKE_COMBO
+    vp, srt, pmi, k, ee = gate.SMOKE_COMBO
+    assert ee > 1  # ISSUE 15: smoke pins the delayed-eviction fetch round
     rep = gate.audit_engine_round(
-        gate._small_engine(vp, srt, pmi, k), ENGINE_ALLOWLIST,
+        gate._small_engine(vp, srt, pmi, k, ee), ENGINE_ALLOWLIST,
         "tier1_smoke",
     )
     assert rep.ok, rep.summary()
     # the audit is not vacuous: dozens of reviewed sinks were exercised
     assert sum(rep.allowed.values()) > 20
     assert rep.n_eqns > 1000
+    # the write half (the standalone flush program) audits clean too
+    repf = gate.audit_engine_flush(
+        gate._small_engine(vp, srt, pmi, k, ee), ENGINE_ALLOWLIST,
+        "tier1_smoke",
+    )
+    assert repf.ok, repf.summary()
 
 
 @pytest.mark.slow

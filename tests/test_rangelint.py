@@ -273,7 +273,7 @@ def test_trace_abort_is_a_finding_not_a_crash():
 
 
 def test_range_mutant_matrix_all_caught():
-    assert len(range_mutant_names()) == 5
+    assert len(range_mutant_names()) == 6
     results = run_range_mutants(RANGE_ALLOWLIST)
     missed = {
         name: (kind, [f.kind for f in rep.findings])
@@ -307,9 +307,10 @@ def test_check_ranges_smoke_gate():
 def test_smoke_engine_audit_exercises_the_allowlist():
     import check_ranges as gate
 
-    vp, srt, pmi, k = gate.SMOKE_COMBO
+    vp, srt, pmi, k, ee = gate.SMOKE_COMBO
     rep = gate.audit_engine_round(
-        gate._engine(5, vp, srt, pmi, k), RANGE_ALLOWLIST, "tier1_smoke",
+        gate._engine(5, vp, srt, pmi, k, ee), RANGE_ALLOWLIST,
+        "tier1_smoke",
     )
     assert rep.ok, rep.summary()
     # not vacuous: the ChaCha/mixer/carry sites really were walked

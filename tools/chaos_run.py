@@ -33,6 +33,7 @@ import argparse
 import hashlib
 import os
 import random
+import shutil
 import signal
 import subprocess
 import sys
@@ -50,7 +51,8 @@ MAX_RESTARTS = 60
 
 def _config(posmap_impl: str | None = None,
             tree_top_cache_levels: int | None = None,
-            pipeline_depth: int | None = None):
+            pipeline_depth: int | None = None,
+            evict_every: int | None = None):
     from grapevine_tpu.config import GrapevineConfig
 
     return GrapevineConfig(
@@ -59,6 +61,7 @@ def _config(posmap_impl: str | None = None,
         posmap_impl=posmap_impl,
         tree_top_cache_levels=tree_top_cache_levels,
         pipeline_depth=pipeline_depth,
+        evict_every=evict_every,
     )
 
 
@@ -106,6 +109,36 @@ def build_schedule(seed: int, n_events: int):
 
 def _resp_hash(resps) -> str:
     return hashlib.sha256(b"".join(r.pack() for r in resps)).hexdigest()
+
+
+def _events_done(events, durable_seq: int, evict_every: int) -> int:
+    """Events covered by the durable journal prefix.
+
+    At evict_every=1 journal seq IS the event count (the original
+    identity). At E>1 every E-th round appends a KIND_FLUSH frame of
+    its own, so the mapping is seq(n) = n + floor(rounds(n)/E) —
+    walked forward here. Recovery completes a pending flush before the
+    child reads ``durability.seq`` (engine/batcher.py), so the durable
+    seq always lands on an event boundary; anything else is journal
+    corruption and must raise, never silently re-run or skip events."""
+    if evict_every <= 1:
+        return durable_seq
+    seq = rounds = 0
+    if seq == durable_seq:
+        return 0
+    for n, ev in enumerate(events):
+        seq += 1  # the event's own frame
+        if ev[0] == "round":
+            rounds += 1
+            if rounds % evict_every == 0:
+                seq += 1  # its flush frame
+        if seq == durable_seq:
+            return n + 1
+    raise RuntimeError(
+        f"durable journal seq {durable_seq} does not land on an event "
+        f"boundary of the {len(events)}-event schedule at "
+        f"evict_every={evict_every}"
+    )
 
 
 def _run_events(engine, events, start: int, progress=None):
@@ -163,7 +196,7 @@ def run_child(args) -> int:
     )
     engine = GrapevineEngine(
         _config(args.posmap_impl, args.tree_top_cache_levels,
-                args.pipeline_depth),
+                args.pipeline_depth, args.evict_every),
         seed=ENGINE_SEED, durability=dcfg,
     )
     monitor = EngineLeakMonitor.for_engine(
@@ -182,7 +215,9 @@ def run_child(args) -> int:
     )
     engine.attach_slo(SloTracker(registry=engine.metrics.registry))
     events = build_schedule(args.schedule_seed, args.events)
-    start = engine.durability.seq  # events[:start] are already durable
+    # events[:start] are already durable (flush frames excluded from
+    # the count — they are cadence bookkeeping, not schedule events)
+    start = _events_done(events, engine.durability.seq, engine.evict_every)
     with open(args.progress, "a") as pf:
         _run_events(engine, events, start, pf)
         monitor.close()  # drain the detector queue before the verdict
@@ -198,7 +233,8 @@ def run_child(args) -> int:
 
 
 def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None,
-           tree_top_cache_levels: int | None = None):
+           tree_top_cache_levels: int | None = None,
+           evict_every: int | None = None):
     """Uninterrupted in-process run: per-seq hashes + final state hash.
 
     Always serial (pipeline_depth=1): the oracle is the pre-PR-10
@@ -209,7 +245,8 @@ def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None,
     from grapevine_tpu.engine.checkpoint import state_to_bytes
 
     engine = GrapevineEngine(
-        _config(posmap_impl, tree_top_cache_levels, pipeline_depth=1),
+        _config(posmap_impl, tree_top_cache_levels, pipeline_depth=1,
+                evict_every=evict_every),
         seed=ENGINE_SEED,
     )
     events = build_schedule(schedule_seed, n_events)
@@ -248,10 +285,65 @@ def _parse_progress(path: str):
     return seq_hashes, finals, leakmons
 
 
+def _fork_cache(shared_dir: str) -> str:
+    """Hardlink-clone the shared XLA compilation cache for ONE child
+    launch. jax 0.4.x's persistent cache writes entries with a plain
+    ``write_bytes`` — NOT atomic — so a SIGKILL mid-compile leaves a
+    torn ``.cache`` prefix that every later process silently loads as
+    a wrong executable (observed: bit-divergent replay the moment a
+    kill site lands near a fresh compile, e.g. the delayed-eviction
+    flush program compiling in the same event as the first
+    checkpoint). Each launch therefore runs against a disposable fork
+    of known-good entries; only launches that EXIT CLEANLY merge their
+    new entries back (atomically) via :func:`_merge_cache`."""
+    d = tempfile.mkdtemp(prefix="chaos-cache-fork-")
+    for name in os.listdir(shared_dir):
+        try:
+            os.link(os.path.join(shared_dir, name), os.path.join(d, name))
+        except OSError:  # pragma: no cover - cross-device fallback
+            try:
+                shutil.copyfile(os.path.join(shared_dir, name),
+                                os.path.join(d, name))
+            except OSError:
+                pass
+    return d
+
+
+def _merge_cache(fork_dir: str, shared_dir: str) -> None:
+    """Promote a CLEAN child's new cache entries into the shared dir
+    with write-tmp + os.replace (the atomicity jax's own put lacks).
+    Existing shared entries are never touched (jax entries are
+    content-addressed by key)."""
+    for name in os.listdir(fork_dir):
+        dst = os.path.join(shared_dir, name)
+        if os.path.exists(dst):
+            continue
+        tmp = dst + f".tmp.{os.getpid()}"
+        try:
+            shutil.copyfile(os.path.join(fork_dir, name), tmp)
+            os.replace(tmp, dst)
+        except OSError:  # pragma: no cover - best-effort cache
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def run_trial(trial: int, mode: str, rng: random.Random, args,
               oracle_hashes, oracle_final, cache_dir: str) -> list[str]:
     """One kill-recover-verify trial; returns a list of failure strings."""
     errors: list[str] = []
+    if mode.startswith("flush.") and (args.evict_every or 1) <= 1:
+        # the flush crash sites only exist under delayed eviction: at
+        # E=1 the engine never reaches them and the "trial" would be a
+        # clean run masquerading as kill coverage — say so instead
+        print(
+            f"trial {trial:3d} [{mode:>26s}]: SKIP "
+            "(evict_every=1 — no flush sites; rerun with "
+            "--evict-every > 1 for kill-at-flush coverage)",
+            flush=True,
+        )
+        return errors
     with tempfile.TemporaryDirectory(prefix=f"chaos{trial}-") as state_dir:
         progress = os.path.join(state_dir, "progress.log")
         child_cmd = [
@@ -268,6 +360,8 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
                           str(args.tree_top_cache_levels)]
         if args.pipeline_depth is not None:
             child_cmd += ["--pipeline-depth", str(args.pipeline_depth)]
+        if args.evict_every is not None:
+            child_cmd += ["--evict-every", str(args.evict_every)]
         base_env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
@@ -278,19 +372,27 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
         launch = 0
         while True:
             env = dict(base_env)
+            # disposable cache fork per launch: a SIGKILL can tear the
+            # non-atomic jax cache writes, and a torn entry silently
+            # loads as a WRONG executable on the next launch (see
+            # _fork_cache) — only clean exits merge entries back
+            cache_fork = _fork_cache(cache_dir)
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_fork
             timer_kill = None
             if launch == 0:
                 if mode == "timer":
                     timer_kill = rng.uniform(1.0, args.timer_max_s)
                 else:
                     # checkpoint sites fire once per --checkpoint-every
-                    # records, append sites once per record — scale the
-                    # trigger count so the fault actually lands mid-run
-                    cap = (
-                        max(2, args.events // args.checkpoint_every)
-                        if mode.startswith("checkpoint.")
-                        else max(2, args.events // 2)
-                    )
+                    # records, flush sites once per evict_every rounds,
+                    # append sites once per record — scale the trigger
+                    # count so the fault actually lands mid-run
+                    if mode.startswith("checkpoint."):
+                        cap = max(2, args.events // args.checkpoint_every)
+                    elif mode.startswith("flush."):
+                        cap = max(2, args.events // max(1, args.evict_every or 1))
+                    else:
+                        cap = max(2, args.events // 2)
                     env["GRAPEVINE_FAULTS"] = f"{mode}={rng.randrange(1, cap)}"
             proc = subprocess.Popen(
                 child_cmd, env=env, cwd=REPO,
@@ -303,6 +405,9 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
                     proc.send_signal(signal.SIGKILL)
             _, err = proc.communicate()
             rc = proc.returncode
+            if rc == 0:
+                _merge_cache(cache_fork, cache_dir)
+            shutil.rmtree(cache_fork, ignore_errors=True)
             if rc == 0:
                 break
             if rc != -signal.SIGKILL:
@@ -361,7 +466,7 @@ def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
     t0 = time.monotonic()
     oracle_hashes, oracle_final = oracle(
         args.schedule_seed, args.events, args.posmap_impl,
-        args.tree_top_cache_levels,
+        args.tree_top_cache_levels, args.evict_every,
     )
     print(f"oracle: {len(oracle_hashes)} events in "
           f"{time.monotonic() - t0:.1f}s", flush=True)
@@ -399,6 +504,16 @@ def parse_args(argv):
     p.add_argument("--tree-top-cache-levels", type=int, default=None,
                    help="tree-top cache depth under test "
                    "(oram/path_oram.py); default = the engine auto")
+    p.add_argument("--evict-every", type=int, default=None,
+                   help="delayed-eviction cadence E under test (engine/"
+                   "batcher.py; oram/round.py:oram_flush): fetch rounds "
+                   "accumulate in the private buffer and the flush "
+                   "journals (KIND_FLUSH) + dispatches with the E-th "
+                   "round — the flush.pre/post_dispatch crash sites are "
+                   "the kill-at-flush windows. The oracle runs the SAME "
+                   "E (serial), so trials prove crash recovery, not "
+                   "cross-E equivalence (that is tests/test_evict.py's "
+                   "logical-content contract). Default = engine auto (1)")
     p.add_argument("--pipeline-depth", type=int, default=None,
                    choices=[1, 2],
                    help="round-pipeline depth under test (engine/"

@@ -63,7 +63,8 @@ LOWER_BETTER = ("_ms", "_ms_per_op", "_s")
 #: signal) and are excluded from the metrics themselves
 GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
-                 "rounds", "slo_target_ms", "pipeline_depth")
+                 "rounds", "slo_target_ms", "pipeline_depth",
+                 "evict_every")
 
 #: result fields that are neither geometry nor a directional metric.
 #: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
@@ -284,6 +285,27 @@ def selftest(factor: float) -> None:
     assert n == 0 and not regs, (
         "sentinel self-test: a depth-keyed capacity line was compared "
         "against the auto-depth baseline"
+    )
+    # evict_every is GEOMETRY (PR 15): an E-keyed line (delayed batched
+    # eviction — amortized flush, a different round program whose
+    # steady-state cost is legitimately ~the fetch half) must never
+    # grade against the E=1 series, in either direction; same-E lines
+    # must still gate each other.
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    b["configs"]["load_scenarios"]["evict_every"] = 4
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: an evict_every-keyed line was compared "
+        "against the E=1 baseline"
+    )
+    c = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    d = mk_cap(200.0, 40.0, 3250.7)
+    c["configs"]["load_scenarios"]["evict_every"] = 4
+    d["configs"]["load_scenarios"]["evict_every"] = 4
+    regs, n = compare_latest(extract_series([c, d]), factor)
+    assert n == 3 and len(regs) == 3, (
+        f"sentinel self-test: same-E series not gated ({n=}, {regs})"
     )
 
 

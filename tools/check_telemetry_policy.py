@@ -304,6 +304,67 @@ def audit_workload_registry() -> dict:
     return report
 
 
+def audit_evict_registry() -> dict:
+    """Runtime pass over the delayed-eviction observability surface
+    (ISSUE-15 satellite — the eviction-buffer occupancy stream plus the
+    ``flush`` phase):
+
+    - the ``grapevine_evict_buffer_occupancy`` / ``_high_water``
+      gauges exist and carry NO label keys — the canary is a per-tree
+      SUM at scrape cadence; any dimension (tree, client, key) would
+      be a finer-grained channel than the reviewed policy admits;
+    - ``flush`` is in the canonical PHASES vocabulary, so the phase
+      histogram, the tracer span allowlist, and the flight recorder's
+      ``phase_s`` schema all admit it (one vocabulary, three surfaces);
+    - schema teeth: the phase histogram accepts ``flush`` and rejects
+      a per-window variant (``flush_w3``) with TelemetryLeakError —
+      a window-numbered phase name is how a schedule-position channel
+      would ride the declared-values contract.
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.engine.metrics import EngineMetrics
+    from grapevine_tpu.obs.flightrec import ALLOWED_PHASE_KEYS
+    from grapevine_tpu.obs.phases import PHASES
+    from grapevine_tpu.obs.registry import TelemetryLeakError
+
+    if "flush" not in PHASES:
+        raise SystemExit(
+            "'flush' missing from obs.phases.PHASES — the delayed-"
+            "eviction dispatch would time under an undeclared name"
+        )
+    if "flush" not in ALLOWED_PHASE_KEYS:
+        raise SystemExit(
+            "'flush' missing from the flight recorder's phase schema"
+        )
+    em = EngineMetrics()
+    report = em.registry.audit()  # raises on any violation
+    for name in ("grapevine_evict_buffer_occupancy",
+                 "grapevine_evict_buffer_high_water"):
+        m = em.registry.get(name)
+        if m is None:
+            raise SystemExit(
+                f"eviction canary {name!r} not registered — the "
+                "overflow runbook (OPERATIONS.md §19) has no signal"
+            )
+        if m.label_keys:
+            raise SystemExit(
+                f"eviction canary {name!r} carries label keys "
+                f"{sorted(m.label_keys)} — the occupancy stream is a "
+                "label-free scrape-cadence sum by policy"
+            )
+    em.observe_phase("flush", 0.001)  # declared value: fine
+    try:
+        em.observe_phase("flush_w3", 0.001)
+    except TelemetryLeakError:
+        pass
+    else:
+        raise SystemExit(
+            "phase histogram accepted the window-numbered phase "
+            "'flush_w3' — the declared-values contract has no teeth"
+        )
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
@@ -312,6 +373,7 @@ def main() -> int:
     lm_report = audit_leakmon_registry()
     ts_report = audit_trace_slo_registry()
     wl_report = audit_workload_registry()
+    audit_evict_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
@@ -320,7 +382,8 @@ def main() -> int:
         f"{lm_report['series']} series incl. engine); trace/slo audit "
         f"ok ({ts_report['trace_slo_families']} families, ring schema "
         f"enforced); workload audit ok ({wl_report['workload_families']} "
-        "families, fixed buckets, depth-field teeth)"
+        "families, fixed buckets, depth-field teeth); evict audit ok "
+        "(label-free buffer canaries, flush phase declared, teeth)"
     )
     return 1 if violations else 0
 

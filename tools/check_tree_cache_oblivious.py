@@ -260,6 +260,182 @@ def check_k0_recursive_census(b: int = 4, height: int = 5) -> dict:
     return {p: sorted({r for _, r in rs}) for p, rs in rows.items() if rs}
 
 
+def _evict_cfg(b: int, height: int, k: int, window: int,
+               recursive: bool = False):
+    from grapevine_tpu.oram.path_oram import OramConfig
+    from grapevine_tpu.oram.posmap import derive_posmap_spec
+
+    pm = (
+        derive_posmap_spec(1 << height, top_cache_levels=k,
+                           evict_window=window, evict_fetch_count=b)
+        if recursive
+        else None
+    )
+    return OramConfig(
+        height=height, value_words=8, n_blocks=1 << height,
+        cipher_rounds=8, top_cache_levels=k, posmap=pm,
+        evict_window=window, evict_fetch_count=b,
+        evict_buffer_slots=4 * b * window,
+    )
+
+
+def check_evict_round_accounting(
+    b: int = 8, height: int = 7, k: int = 2, window: int = 2,
+    verbose: bool = False, recursive: bool = False,
+) -> dict:
+    """The delayed-eviction (PR 15) extension of this gate: the E-round
+    schedule's HBM row accounting, trace-level.
+
+    Three claims over one ``evict_window = E`` geometry:
+
+    1. **Fetch rounds are read-only on HBM.** The fetch-only round's
+       census is identical across adversarial index sets (index-blind,
+       claim 1 of the per-round audit), its tree-plane GATHERS move
+       exactly ``B·(path_len−k)`` bucket rows per plane — the same
+       fetch traffic as the E=1 round — and it contains ZERO scatters
+       on any tree/nonce/cache plane: the scatter+encrypt half of the
+       round is really gone from the steady state.
+    2. **The flush writes exactly the window, deduplicated.** One
+       ``oram_flush`` scatters exactly ``flush_target_slots =
+       min(E·B·path_len, n_buckets_padded)`` bucket rows per plane —
+       the union of the window's fetched paths written ONCE each
+       (write transcript ≡ the deduplicated union of the window's read
+       transcripts; the ``min`` is the amortization: past tree
+       saturation, extra window rounds add fetch traffic but no write
+       traffic) — with ZERO tree-plane gathers (the live rows were
+       already pulled into the buffer at fetch time). Cache planes see
+       the same ``t``-row shape at k>0 (cached targets peel off by the
+       heap-prefix mask).
+    3. **Recipient-independence of the cadence.** Both programs trace
+       with the batch indices baked in as constants; identical censuses
+       across index sets plus a bucket-target set that is a pure
+       function of the (public) leaves means nothing about which
+       recipients were touched can move a row or a flush.
+
+    Returns the per-program row accounting.
+    """
+    from grapevine_tpu.oram.round import flush_target_slots
+
+    cfg = _evict_cfg(b, height, k, window, recursive)
+    plen = cfg.path_len
+    want_fetch = b * (plen - k)
+    want_flush = flush_target_slots(cfg)
+    # the audit needs the UNSATURATED dedup regime: at t =
+    # n_buckets_padded the compacted output planes coincide in shape
+    # with the HBM tree planes and shape-based attribution would count
+    # private scatters as tree traffic (a false positive, not a leak).
+    # The saturated cap is pure arithmetic, pinned below.
+    assert want_flush < cfg.n_buckets_padded, (
+        "audit geometry must keep the flush target set unsaturated "
+        f"(t={want_flush} vs n_buckets_padded={cfg.n_buckets_padded}) — "
+        "raise height or lower window/batch"
+    )
+    # the saturation clamp itself (the amortization bound): arithmetic,
+    # no trace needed
+    sat = _evict_cfg(b, 3, 0, 8, False)
+    assert flush_target_slots(sat) == sat.n_buckets_padded
+
+    # -- 1. fetch round: index-blind + read-only ------------------------
+    censuses = {
+        iname: _census(_trace_round(cfg, idxs, b))
+        for iname, idxs in _index_sets(cfg, b).items()
+    }
+    base_name, base = next(iter(censuses.items()))
+    for iname, c in censuses.items():
+        assert c == base, (
+            f"E={window}: fetch round traces a DIFFERENT program for "
+            f"index set {iname!r} vs {base_name!r}: "
+            f"{(c - base) + (base - c)}"
+        )
+    n_control = sum(base[p] for p in _CONTROL_PRIMS)
+    assert n_control == 0, (
+        f"E={window}: data-dependent control flow in the fetch round "
+        f"({ {p: base[p] for p in _CONTROL_PRIMS if base[p]} })"
+    )
+    rows = _plane_rows(
+        _trace_round(cfg, _index_sets(cfg, b)["mixed_dups"], b), cfg
+    )
+    fetch_acct = {}
+    tree_planes = ["tree_idx", "tree_val", "nonces"]
+    if recursive:
+        tree_planes.append("tree_leaf")
+    for pname in tree_planes:
+        moved = rows[pname]
+        gathers = [r for op, r in moved if op == "gather"]
+        scatters = [(op, r) for op, r in moved if op != "gather"]
+        assert not scatters, (
+            f"E={window}: fetch round SCATTERS to {pname} ({scatters}) "
+            "— the steady-state round must be read-only on the HBM tree"
+        )
+        if pname != "nonces" or cfg.encrypted:
+            assert gathers and all(r == want_fetch for r in gathers), (
+                f"E={window}: {pname} fetch gathers move "
+                f"{sorted(set(gathers))} rows — want exactly "
+                f"B·(path_len−k) = {want_fetch}"
+            )
+        fetch_acct[pname] = sorted(set(gathers))
+    if k:
+        for pname in ("cache_idx", "cache_val"):
+            moved = rows[pname]
+            assert all(op == "gather" for op, _ in moved), (
+                f"E={window}: fetch round writes the cache plane "
+                f"{pname} — cached levels flush with everything else"
+            )
+
+    # -- 2. flush: writes exactly the window, reads nothing -------------
+    import jax
+
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.oram.round import oram_flush
+
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    fl_jaxpr = jax.make_jaxpr(lambda st: oram_flush(cfg, st))(state)
+    frows = _shared_plane_rows(fl_jaxpr, _tree_planes(cfg))
+    flush_acct = {}
+    for pname in tree_planes:
+        moved = frows[pname]
+        gathers = [r for op, r in moved if op == "gather"]
+        scatters = [r for op, r in moved if op != "gather"]
+        assert not gathers, (
+            f"E={window}: flush GATHERS from {pname} — the window's "
+            "live rows were already pulled into the buffer at fetch "
+            "time; a flush-time read is a second, unaccounted pass"
+        )
+        if pname != "nonces" or cfg.encrypted:
+            assert scatters and all(r == want_flush for r in scatters), (
+                f"E={window}: {pname} flush scatters move "
+                f"{sorted(set(scatters))} rows — want exactly "
+                f"flush_target_slots = min(E·B·path_len, "
+                f"n_buckets_padded) = {want_flush}"
+            )
+        flush_acct[pname] = sorted(set(scatters))
+    if k:
+        # recursive geometries: the INNER tree's cache planes share the
+        # outer cache planes' shape (both (2^k−1)·Z), so shape-based
+        # attribution folds the inner flush's cache writes in — accept
+        # the inner t-row shape alongside the outer one
+        want_cache = {want_flush}
+        if recursive:
+            from grapevine_tpu.oram.posmap import inner_oram_config
+
+            want_cache.add(flush_target_slots(inner_oram_config(cfg.posmap)))
+        for pname in ("cache_idx", "cache_val"):
+            moved = frows[pname]
+            scatters = [r for op, r in moved if op != "gather"]
+            assert scatters and set(scatters) <= want_cache and (
+                want_flush in scatters
+            ), (
+                f"E={window}: cache plane {pname} flush moves "
+                f"{moved} — want the t-row target shape(s) {want_cache}"
+            )
+    out = {"fetch": fetch_acct, "flush": flush_acct,
+           "want_fetch_rows": want_fetch, "want_flush_rows": want_flush}
+    if verbose:
+        print(f"E={window} k={k} "
+              f"({'recursive' if recursive else 'flat'}): {out}")
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -275,8 +451,15 @@ def main(argv=None) -> int:
         print(f"[check_tree_cache_oblivious] recursive={recursive}: OK {out}")
     out = check_k0_recursive_census(b=4, height=5)
     print(f"[check_tree_cache_oblivious] k0-recursive cell: OK {out}")
+    for recursive in (False, True):
+        out = check_evict_round_accounting(verbose=True,
+                                           recursive=recursive)
+        print(f"[check_tree_cache_oblivious] evict schedule "
+              f"(recursive={recursive}): OK")
     print("[check_tree_cache_oblivious] PASS: cached round is index-blind "
-          "and HBM path traffic is exactly B·(path_len−k) rows per plane")
+          "and HBM path traffic is exactly B·(path_len−k) rows per plane; "
+          "delayed-eviction fetch rounds are HBM-read-only and each flush "
+          "writes exactly the E-round window")
     return 0
 
 

@@ -766,6 +766,77 @@ def stage_pipeline_perf(cap, args):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def stage_evict_perf(cap, args):
+    """Delayed-eviction cadence A/B on the real device round (PR 15;
+    the ROADMAP item-1 decision number — the seventh banked-decision
+    stage). For each ``evict_every`` in {1, 4}: an engine serves a
+    steady open-loop stream through the production scheduler; banked
+    per arm: achieved throughput, commit p50/p99, and the bubble ratio
+    UNDER load — the E=4 arm's flush dispatches async behind the
+    window's last round, so on a device-bound host the flush should
+    ride the idle window the bubble ratio prices and the arm should
+    approach fetch-only cadence. This is the number that settles the
+    ``evict_every`` auto default (currently 1 on every backend)."""
+    import numpy as np
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ScenarioRunner,
+        calibrate_unloaded_round,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    cl, b, dur = (14, 16, 4.0) if args.quick else (18, 256, 10.0)
+    out = {"capacity_log2": cl, "batch": b}
+    est = None
+    for ee in (1, 4):
+        cfg = GrapevineConfig(
+            max_messages=1 << cl, max_recipients=1 << 10,
+            batch_size=b, evict_every=ee,
+        )
+        engine = GrapevineEngine(cfg)
+        # calibrate EVERY arm (warms each arm's own compile — the
+        # pipeline_perf discipline); only the FIRST arm's estimate sets
+        # the offered rate so both arms see the same absolute stream
+        t_round, est_arm, _ = calibrate_unloaded_round(
+            engine, 1_700_000_000)
+        if est is None:
+            est = est_arm
+            out["calibrated_round_ms"] = round(t_round * 1e3, 2)
+        tracer = RoundTracer(capacity=2048,
+                             registry=engine.metrics.registry)
+        engine.attach_tracer(tracer)
+        sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+        try:
+            runner = ScenarioRunner(sched, n_idents=64,
+                                    settle_timeout_s=180.0)
+            res = runner.run(steady_poisson(0.6 * est, dur, seed=31))
+        finally:
+            sched.close()
+            engine.close()
+        trace = tracer.chrome_trace()
+        s = res.summary()
+        h = engine.health()
+        out[f"e{ee}"] = {
+            "achieved_ops_per_sec": s.get("achieved_ops_per_sec"),
+            "p99_commit_ms": s.get("p99_commit_ms"),
+            "p50_commit_ms": s.get("p50_commit_ms"),
+            "bubble_ratio_under_load":
+                trace["otherData"]["bubble_ratio"],
+            "rounds": trace["otherData"]["rounds_recorded_total"],
+            "stash_overflow": h["stash_overflow"],
+            "evict_buffer_occupancy": h.get("evict_buffer_occupancy"),
+        }
+    e1, e4 = out["e1"], out["e4"]
+    if e1.get("achieved_ops_per_sec") and e4.get("achieved_ops_per_sec"):
+        out["throughput_ratio_e4_over_e1"] = round(
+            e4["achieved_ops_per_sec"] / e1["achieved_ops_per_sec"], 3)
+    cap.emit("evict_perf", **out)
+
+
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
@@ -787,6 +858,10 @@ STAGES = [
     # compiles) and the depth A/B + under-load bubble is the other half
     # of the ROADMAP-item-2 decision pair
     ("pipeline_perf", stage_pipeline_perf, 1200),
+    # evict_perf right after pipeline_perf: same geometry family, and
+    # the E A/B + flush-overlap bubble is the ROADMAP-item-1 decision
+    # number that settles the evict_every auto (PR 15)
+    ("evict_perf", stage_evict_perf, 1200),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
