@@ -443,6 +443,7 @@ class GrapevineEngine:
             faults.crash("flush.pre_dispatch")
         with self.metrics.time_phase("flush"):
             self.state = self._flush_step(self.ecfg, self.state)
+        self.metrics.record_flush()
         if faults.active():
             faults.crash("flush.post_dispatch")
         self._rounds_since_flush = 0

@@ -419,7 +419,7 @@ class DurabilityManager:
         self.root_key = load_or_create_root_key(key_path)
         self._c_records = self._c_fsyncs = self._c_ckpts = None
         self._g_durable = self._g_ckpt = self._g_replayed = None
-        self._g_recovery_s = None
+        self._g_recovery_s = self._g_applied = None
         if registry is not None:
             self._c_records = registry.counter(
                 "grapevine_journal_records_total",
@@ -442,12 +442,26 @@ class DurabilityManager:
             self._g_recovery_s = registry.gauge(
                 "grapevine_recovery_seconds",
                 "wall time of the last startup recovery")
+            self._g_applied = registry.gauge(
+                "grapevine_journal_applied_seq",
+                "highest journal sequence applied to engine state (on "
+                "the primary this tracks journal_seq; on a follower "
+                "replaying shipped journal frames it is the replication "
+                "frontier — the fleet aggregator derives "
+                "grapevine_fleet_journal_lag_seq from it; ROADMAP "
+                "item 4, OPERATIONS.md §20)")
         self.journal = BatchJournal(
             dcfg.state_dir, self.root_key, ecfg,
             fsync_every=dcfg.journal_fsync_every,
             on_fsync=self._note_fsync,
         )
         self.ckpt_seq = 0  # journal seq covered by the newest checkpoint
+        #: highest journal seq applied to engine state. On the primary
+        #: this tracks journal.seq (each record is applied as part of
+        #: the round that journals it); on a follower consuming shipped
+        #: frames it trails the primary's durable seq — the replication
+        #: lag the fleet aggregator prices (obs/fleet.py).
+        self.applied_seq = 0
         self.replayed = 0
         self.recovered_from_checkpoint = False
 
@@ -484,9 +498,11 @@ class DurabilityManager:
             self.ckpt_seq = seq
             self.recovered_from_checkpoint = True
         self.replayed = 0
+        self.note_applied_seq(self.ckpt_seq)
         for rec in self.journal.replay(after_seq=self.ckpt_seq):
             state = apply_fn(state, rec)
             self.replayed += 1
+            self.note_applied_seq(self.journal.seq)
             if self._g_replayed is not None:
                 self._g_replayed.set(self.replayed)
         self.journal.open_for_append()
@@ -502,16 +518,28 @@ class DurabilityManager:
     def seq(self) -> int:
         return self.journal.seq
 
+    def note_applied_seq(self, seq: int) -> None:
+        """Record that engine state now reflects journal records up to
+        ``seq``. The primary calls this implicitly from the append path;
+        a follower replaying shipped frames calls it per applied record
+        — the gauge is what the fleet aggregator scrapes to derive
+        replication lag."""
+        self.applied_seq = seq
+        if self._g_applied is not None:
+            self._g_applied.set(seq)
+
     def append_round(self, batch: dict, n_real: int) -> int:
         seq = self.journal.append_round(batch, n_real)
         if self._c_records is not None:
             self._c_records.inc()
+        self.note_applied_seq(seq)
         return seq
 
     def append_sweep(self, now: int, now_hi: int, period: int) -> int:
         seq = self.journal.append_sweep(now, now_hi, period)
         if self._c_records is not None:
             self._c_records.inc()
+        self.note_applied_seq(seq)
         return seq
 
     def append_flush(self) -> int:
@@ -520,6 +548,7 @@ class DurabilityManager:
         seq = self.journal.append_flush()
         if self._c_records is not None:
             self._c_records.inc()
+        self.note_applied_seq(seq)
         return seq
 
     def should_checkpoint(self) -> bool:
@@ -556,6 +585,7 @@ class DurabilityManager:
         return {
             "last_durable_seq": self.journal.durable_seq,
             "journal_seq": self.journal.seq,
+            "applied_seq": self.applied_seq,
             "last_checkpoint_seq": self.ckpt_seq,
             "recovery_replayed_records": self.replayed,
         }

@@ -320,6 +320,25 @@ class BatchJournal:
                     self._tail = (path, off)
         self.durable_seq = self.seq
 
+    def follow(self, after_seq: int = 0) -> Iterator[JournalRecord]:
+        """Read-only replication tail: yield records with seq >
+        ``after_seq`` exactly like :meth:`replay`, but for a follower
+        that will never append — point a second BatchJournal at a
+        shipped copy of the primary's state dir and apply records to
+        standby state, reporting progress via
+        ``DurabilityManager.note_applied_seq`` (the
+        ``grapevine_journal_applied_seq`` gauge the fleet aggregator
+        turns into replication lag; ROADMAP item 4, OPERATIONS.md §20).
+        Each call rescans the directory, so repeated calls pick up
+        newly shipped segments; a torn final frame is skipped this call
+        and retried on the next."""
+        if self._fd is not None:
+            raise RuntimeError(
+                "follow() is for read-only followers; this journal is "
+                "open for append"
+            )
+        yield from self.replay(after_seq=after_seq)
+
     # -- append ---------------------------------------------------------
 
     def open_for_append(self) -> None:

@@ -68,6 +68,11 @@ class EngineMetrics:
             "grapevine_expiry_sweeps_total", "expiry sweeps run")
         self._c_evicted = r.counter(
             "grapevine_expired_records_total", "records evicted by expiry")
+        self._c_flushes = r.counter(
+            "grapevine_evict_flushes_total",
+            "delayed-eviction window flushes dispatched (cadence is a "
+            "pure function of the round counter — the fleet uniformity "
+            "monitor compares flush phase across shards)")
         self._c_verifies = r.counter(
             "grapevine_batch_verifies_total",
             "round-level batched signature verifications")
@@ -132,6 +137,9 @@ class EngineMetrics:
     def record_sweep(self, evicted: int) -> None:
         self._c_sweeps.inc()
         self._c_evicted.inc(evicted)
+
+    def record_flush(self) -> None:
+        self._c_flushes.inc()
 
     def record_auth(self, failures: int = 0) -> None:
         self._c_verifies.inc()

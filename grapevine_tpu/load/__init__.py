@@ -14,6 +14,14 @@ replay harness that drives them through the production BatchScheduler:
 - ``capacity``: per-step SLO accounting over a ramp schedule and the
   saturation-knee model behind the repo's banked capacity number
   (``bench.py load_scenarios``).
+
+Fleet-shaped replay (ISSUE 16): ``partition_schedule`` splits one
+schedule across N shards by recipient space, ``ShardedScenarioRunner``
+replays the parts against N schedulers concurrently,
+``ShardRoundDriver`` is the cross-shard schedule-uniformity
+discrimination drill (uniform contract vs the seeded skewed-scheduler
+mutant), and ``fleet_capacity`` folds per-shard knees into the
+fleet-wide grade banked under the ``shard_count`` geometry key.
 """
 
 from .generators import (  # noqa: F401
@@ -21,6 +29,7 @@ from .generators import (  # noqa: F401
     adversarial_probe,
     bursty_onoff,
     diurnal_sinusoid,
+    partition_schedule,
     pop_heavy_drain,
     ramp_to_saturation,
     steady_poisson,
@@ -29,6 +38,9 @@ from .harness import (  # noqa: F401
     ProbeCampaignInjector,
     RunResult,
     ScenarioRunner,
+    ShardedScenarioRunner,
+    ShardRoundDriver,
     calibrate_unloaded_round,
+    materialize_request,
 )
-from .capacity import analyze_ramp, find_knee  # noqa: F401
+from .capacity import analyze_ramp, find_knee, fleet_capacity  # noqa: F401

@@ -157,3 +157,35 @@ def analyze_ramp(schedule: Schedule, result, target_ms: float,
         "steps": graded,
         **knee,
     }
+
+
+def fleet_capacity(shard_analyses: list[dict]) -> dict:
+    """Fold N per-shard ramp analyses (``analyze_ramp`` output, shard
+    order) into the fleet grade.
+
+    The declared partition serves disjoint recipient spaces, so shard
+    knees ADD into the fleet knee — but only while every shard holds:
+    the fleet is ``saturated`` as soon as ANY shard saturates (one hot
+    shard past its knee is a capacity failure the sum must not paper
+    over; the sum reported for a saturated fleet is still the additive
+    lower bound of the holding knees). Banked by bench.py
+    ``fleet_loopback`` under the ``shard_count`` geometry key
+    (tools/check_perf_regression.py) so an N=2 number never grades
+    against the N=1 series."""
+    if not shard_analyses:
+        raise ValueError("need at least one shard analysis")
+    return {
+        "shard_count": len(shard_analyses),
+        "fleet_knee_ops_per_sec": round(
+            sum(a["knee_ops_per_sec"] for a in shard_analyses), 1),
+        "saturated": any(a["saturated"] for a in shard_analyses),
+        "shards": [
+            {
+                "shard": i,
+                "knee_ops_per_sec": a["knee_ops_per_sec"],
+                "knee_p99_commit_ms": a.get("knee_p99_commit_ms"),
+                "saturated": a["saturated"],
+            }
+            for i, a in enumerate(shard_analyses)
+        ],
+    }

@@ -288,6 +288,49 @@ def adversarial_probe(pulse_period_s: float, duration_s: float, seed: int,
     )
 
 
+def partition_schedule(schedule: Schedule, n_shards: int) -> list:
+    """Split one schedule into N per-shard schedules by recipient space
+    — the declared partition a recipient-sharded fleet would serve
+    (ROADMAP item 1: each shard owns ``recipient % n_shards == i``).
+
+    CREATEs route by their recipient; zero-id READ/DELETE drains route
+    by the submitter (``auth``), since a drain empties the submitter's
+    own inbox, which lives on the submitter's home shard. The split is
+    a pure function of (schedule, n_shards): replaying shard i's
+    sub-schedule is deterministic, and the union of the parts is the
+    whole (asserted) — so a fleet replay offers exactly the same
+    traffic as the monolithic replay, just partitioned.
+
+    Each part's ``meta`` carries ``shard``/``n_shards``/``partition``
+    plus the parent envelope — the fleet uniformity monitor's
+    *declared* load split, against which fill-correlation beyond the
+    declared partition is the leak (obs/leakmon.py
+    FleetUniformityMonitor)."""
+    if n_shards < 1:
+        raise ValueError("n_shards must be >= 1")
+    route = np.where(
+        schedule.kind == CREATE,
+        schedule.recipient % n_shards,
+        schedule.auth % n_shards,
+    )
+    parts = []
+    for i in range(n_shards):
+        sel = route == i
+        parts.append(Schedule(
+            scenario=f"{schedule.scenario}[shard{i}/{n_shards}]",
+            seed=schedule.seed,
+            duration_s=schedule.duration_s,
+            t_s=schedule.t_s[sel],
+            kind=schedule.kind[sel],
+            auth=schedule.auth[sel],
+            recipient=schedule.recipient[sel],
+            meta={**schedule.meta, "shard": i, "n_shards": n_shards,
+                  "partition": "recipient_mod"},
+        ))
+    assert sum(p.n_ops for p in parts) == schedule.n_ops
+    return parts
+
+
 def ramp_to_saturation(rate0: float, factor: float, n_steps: int,
                        step_s: float, seed: int,
                        n_idents: int = 64) -> Schedule:

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..wire import constants as C
 from ..wire.records import QueryRequest, RequestRecord
-from .generators import CREATE, Schedule
+from .generators import CREATE, Schedule, partition_schedule
 
 #: response statuses that mean "the engine handled the op as specified"
 #: under load: drains of an empty inbox are NOT_FOUND, creates against
@@ -93,6 +93,32 @@ def calibrate_unloaded_round(engine, now: int, reps: int = 3) -> tuple:
         ts.append(time.perf_counter() - t0)
     t_round = min(ts)
     return t_round, batch / t_round, max(250.0, 8.0 * t_round * 1e3)
+
+
+def materialize_request(idents: list, schedule: Schedule, i: int,
+                        payload: bytes) -> QueryRequest:
+    """Op template → signed-shape wire request: CREATEs aim at the
+    recipient's pool identity; zero-id READ/DELETE drains pop the
+    submitter's own inbox. Module-level so the single-process runner
+    and the per-shard fleet replay materialize identically from ONE
+    identity pool (a shard's sub-schedule indexes the same principals
+    the monolithic schedule declared)."""
+    kind = int(schedule.kind[i])
+    auth = idents[int(schedule.auth[i]) % len(idents)]
+    if kind == CREATE:
+        rcp = idents[int(schedule.recipient[i]) % len(idents)]
+        rec = RequestRecord(
+            msg_id=C.ZERO_MSG_ID, recipient=rcp, payload=payload
+        )
+    else:  # zero-id READ/DELETE: pop the submitter's own inbox
+        rec = RequestRecord(
+            msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY,
+            payload=payload,
+        )
+    return QueryRequest(
+        request_type=kind, auth_identity=auth,
+        auth_signature=b"\x01" * C.SIGNATURE_SIZE, record=rec,
+    )
 
 
 class RunResult:
@@ -171,22 +197,7 @@ class ScenarioRunner:
         self._clock = clock
 
     def _materialize(self, schedule: Schedule, i: int) -> QueryRequest:
-        kind = int(schedule.kind[i])
-        auth = self.idents[int(schedule.auth[i]) % len(self.idents)]
-        if kind == CREATE:
-            rcp = self.idents[int(schedule.recipient[i]) % len(self.idents)]
-            rec = RequestRecord(
-                msg_id=C.ZERO_MSG_ID, recipient=rcp, payload=self.payload
-            )
-        else:  # zero-id READ/DELETE: pop the submitter's own inbox
-            rec = RequestRecord(
-                msg_id=C.ZERO_MSG_ID, recipient=C.ZERO_PUBKEY,
-                payload=self.payload,
-            )
-        return QueryRequest(
-            request_type=kind, auth_identity=auth,
-            auth_signature=b"\x01" * C.SIGNATURE_SIZE, record=rec,
-        )
+        return materialize_request(self.idents, schedule, i, self.payload)
 
     def run(self, schedule: Schedule) -> RunResult:
         """Replay one schedule open-loop; blocks until every dispatched
@@ -326,3 +337,155 @@ class ProbeCampaignInjector:
             tr[j, d + 1 + c] = leaf   # mailbox round C column
         return self.monitor.submit_round(
             batch, tr, n_real, batch_size, phases, queue_depth)
+
+
+# ----------------------------------------------------------------------
+# per-shard fleet replay (ISSUE 16 — ROADMAP item 1 substrate)
+# ----------------------------------------------------------------------
+
+
+class ShardedScenarioRunner:
+    """Replay ONE schedule across N shard schedulers, partitioned by
+    recipient space (generators.partition_schedule) — the fleet-shaped
+    replay the aggregator (obs/fleet.py) observes.
+
+    Each shard's sub-schedule runs open-loop on its own thread against
+    its own scheduler, all from one shared identity pool and one shared
+    clock origin, so the fleet is offered exactly the traffic the
+    monolithic replay would offer — just partitioned the way a
+    recipient-sharded deployment declares. Returns per-shard
+    ``RunResult``s in shard order; capacity grading folds them with
+    ``load.capacity.fleet_capacity``."""
+
+    def __init__(self, schedulers: list, n_idents: int = 64,
+                 time_scale: float = 1.0, payload: bytes | None = None,
+                 settle_timeout_s: float = 120.0, clock=time.perf_counter):
+        if not schedulers:
+            raise ValueError("need at least one shard scheduler")
+        self.runners = [
+            ScenarioRunner(
+                s, n_idents=n_idents, time_scale=time_scale,
+                payload=payload, settle_timeout_s=settle_timeout_s,
+                clock=clock,
+            )
+            for s in schedulers
+        ]
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.runners)
+
+    def run(self, schedule: Schedule) -> list:
+        parts = partition_schedule(schedule, self.n_shards)
+        results: list = [None] * self.n_shards
+        errors: list = []
+
+        def _one(i):
+            try:
+                results[i] = self.runners[i].run(parts[i])
+            except Exception as exc:  # surfaced after join, not lost
+                errors.append((i, exc))
+
+        threads = [
+            threading.Thread(target=_one, args=(i,),
+                             name=f"grapevine-shard-replay-{i}")
+            for i in range(self.n_shards)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            i, exc = errors[0]
+            raise RuntimeError(f"shard {i} replay failed") from exc
+        return results
+
+
+class ShardRoundDriver:
+    """The cross-shard discrimination drill: N shard round loops on a
+    shared tick clock feeding a ``FleetUniformityMonitor``.
+
+    ``policy="uniform"`` is the production contract: every shard
+    dispatches exactly one round per tick whether or not its queue
+    holds real ops (cadence a pure function of the clock — padded
+    rounds are the price of obliviousness). ``policy="skewed"`` is the
+    seeded mutant ISSUE 16 requires: a shard dispatches a round ONLY
+    when its own queue is hot (depth >= ``hot_threshold``), i.e. the
+    scheduler leaks per-shard offered load into per-shard cadence —
+    exactly what a traffic observer at fleet grain could read
+    recipient activity from. The fleet verdict must flip SUSPECT on
+    the mutant within a bounded number of ticks while the uniform
+    policy stays PASS under any arrival shape (tests/test_fleet.py).
+
+    ``round_fn(shard, n_real)`` optionally runs a REAL engine round
+    per dispatch (the slow soaks drive live engines); default is pure
+    queue accounting, which is all the monitor ever sees either way —
+    it consumes only the public per-shard series.
+    """
+
+    POLICIES = ("uniform", "skewed")
+
+    def __init__(self, n_shards: int, monitor, policy: str = "uniform",
+                 batch_size: int = 8, hot_threshold: int = 4,
+                 flush_every: int = 4, round_fn=None):
+        if policy not in self.POLICIES:
+            raise ValueError(f"policy must be one of {self.POLICIES}")
+        if n_shards < 2:
+            raise ValueError("the drill needs >= 2 shards")
+        self.n = int(n_shards)
+        self.monitor = monitor
+        self.policy = policy
+        self.batch_size = int(batch_size)
+        self.hot_threshold = int(hot_threshold)
+        self.flush_every = max(1, int(flush_every))
+        self.round_fn = round_fn
+        self.queue = [0] * self.n
+        self.rounds = [0] * self.n
+        self.fill_sum = [0.0] * self.n
+        self.flushes = [0] * self.n
+        self.ticks = 0
+
+    def tick(self, arrivals) -> None:
+        """One shared tick: enqueue per-shard arrivals, apply the
+        dispatch policy, hand the monitor the cumulative public
+        series."""
+        if len(arrivals) != self.n:
+            raise ValueError("arrivals must have one entry per shard")
+        for i, a in enumerate(arrivals):
+            self.queue[i] += int(a)
+        for i in range(self.n):
+            if self.policy == "skewed" and \
+                    self.queue[i] < self.hot_threshold:
+                continue  # the leak: cadence follows the shard's load
+            n_real = min(self.queue[i], self.batch_size)
+            self.queue[i] -= n_real
+            if self.round_fn is not None:
+                self.round_fn(i, n_real)
+            self.rounds[i] += 1
+            self.fill_sum[i] += n_real / self.batch_size
+            if self.rounds[i] % self.flush_every == 0:
+                self.flushes[i] += 1
+        self.ticks += 1
+        self.monitor.observe_tick([
+            {
+                "rounds_total": float(self.rounds[i]),
+                "fill_sum": self.fill_sum[i],
+                "fill_count": float(self.rounds[i]),
+                "flushes_total": float(self.flushes[i]),
+                "queue_depth": float(self.queue[i]),
+            }
+            for i in range(self.n)
+        ])
+
+    def run(self, arrival_fn, n_ticks: int, stop_on=None) -> dict:
+        """Drive ``n_ticks`` ticks with ``arrival_fn(tick) ->
+        per-shard arrivals``; returns the final monitor verdict.
+        ``stop_on`` (e.g. ``"SUSPECT"``) ends the drill early at the
+        first matching verdict — the bounded-detection measurement."""
+        verdict = self.monitor.verdict()
+        for k in range(n_ticks):
+            self.tick(arrival_fn(k))
+            verdict = self.monitor.verdict()
+            if stop_on is not None and verdict["verdict"] == stop_on:
+                break
+        return {**verdict, "ticks": self.ticks}

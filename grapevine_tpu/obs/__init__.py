@@ -37,7 +37,13 @@ leak policy structurally rather than by convention:
   fill-fraction and queue-depth histograms at round cadence, an
   arrival-rate EWMA gauge, per-phase utilization from the tracer span
   ledgers, and saturation/backpressure counters (the signals the
-  ``grapevine_tpu/load`` scenario harness measures against).
+  ``grapevine_tpu/load`` scenario harness measures against);
+- ``fleet``: the multi-process observatory — a stdlib aggregator
+  scraping N member processes on a fixed public cadence and serving
+  merged shard-labeled /metrics, folded /healthz and /leakaudit, the
+  cross-shard schedule-uniformity detectors
+  (``leakmon.FleetUniformityMonitor``), and replication-lag gauges
+  (ROADMAP items 1/2/4).
 """
 
 from .registry import (  # noqa: F401
@@ -55,9 +61,12 @@ from .httpd import MetricsServer  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
 from .leakmon import (  # noqa: F401
     EngineLeakMonitor,
+    FleetUniformityConfig,
+    FleetUniformityMonitor,
     LeakMonitorConfig,
     TranscriptLeakMonitor,
 )
+from .fleet import FleetAggregator, FleetConfig, parse_exposition  # noqa: F401
 from .tracer import RoundTracer  # noqa: F401
 from .slo import SloConfig, SloTracker  # noqa: F401
 from .profiler import ProfilerBusy, ProfilerGate  # noqa: F401

@@ -63,8 +63,13 @@ class MetricsServer:
         flightrec=None,
         trace=None,
         profile=None,
+        render=None,
     ):
         self.registry = registry
+        #: optional zero-arg callable returning the /metrics exposition
+        #: text — the fleet aggregator (obs/fleet.py) substitutes its
+        #: merged member view; default is this registry's own exposition
+        self.render = render
         self.health = health or (lambda: (True, {}))
         self.leakaudit = leakaudit
         self.flightrec = flightrec
@@ -102,7 +107,10 @@ class MetricsServer:
                             outer.refresh()
                         except Exception:
                             log.exception("metrics refresh hook failed")
-                    body = render_prometheus(outer.registry).encode()
+                    if outer.render is not None:
+                        body = outer.render().encode()
+                    else:
+                        body = render_prometheus(outer.registry).encode()
                     self._reply(
                         200, body, "text/plain; version=0.0.4; charset=utf-8"
                     )

@@ -624,3 +624,319 @@ class EngineLeakMonitor:
             return
         self._q.put(None)
         self._worker.join(timeout=timeout)
+
+
+# ----------------------------------------------------------------------
+# cross-shard schedule uniformity (the fleet observatory's detector leg)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetUniformityConfig:
+    """Thresholds and window sizing for the cross-shard detectors
+    (defaults justified in OPERATIONS.md §20)."""
+
+    #: sliding window length in aligned fleet ticks (one tick = one
+    #: same-instant observation of every shard — a scrape cycle in
+    #: production, a dispatch tick in the load drill)
+    window_ticks: int = 128
+    #: minimum aligned ticks before the correlation detector may trip
+    min_ticks: int = 24
+    #: minimum per-shard rounds in the window before the cadence and
+    #: flush detectors may trip (insufficient evidence reports PASS —
+    #: the PR-2 min-samples stance)
+    min_rounds: int = 16
+    #: |log cadence ratio| floor for the pairwise cadence detector: an
+    #: honest uniformly-scheduled fleet keeps every pair's windowed
+    #: round-count ratio near 1 (drift |log r| = O(sqrt(1/R))); 0.35
+    #: tolerates a 1.4x transient imbalance before suspicion
+    cadence_ratio_floor: float = 0.35
+    #: Fisher-z threshold for the dispatch-vs-offered-load correlation
+    #: detector (honest uniform scheduling dispatches unconditionally,
+    #: so the correlation is sampling noise: |z| = O(1))
+    corr_z_threshold: float = 6.0
+    #: pairwise flush-per-round rate drift floor (honest shards all
+    #: flush at the declared 1/evict_every cadence)
+    flush_rate_floor: float = 0.1
+    #: sampling-noise margin in standard deviations for the cadence and
+    #: flush thresholds (the leakmon rate_z_margin analog)
+    rate_z_margin: float = 8.0
+
+
+class FleetUniformityMonitor:
+    """Cross-shard schedule-uniformity detectors over PUBLIC series.
+
+    The single-process monitors above judge one engine's transcript.
+    A recipient-sharded fleet has a second obliviousness obligation the
+    ROADMAP (item 1) names explicitly: per-shard round cadence and
+    batch shape must stay recipient-independent — a scheduler that
+    dispatches shard s's round only when s's own queue is hot encodes
+    *which shard's recipients are busy* into the public round schedule,
+    exactly the signal BOLT's fleet-level adversary reads. This monitor
+    consumes only per-shard batch-level time series (round cadence,
+    batch fill, flush cadence, queue depth at round/scrape grain — all
+    already public on each member's /metrics) and flags
+    recipient-dependent skew:
+
+    1. **pairwise cadence-ratio drift** — windowed round-count ratios
+       between shards must stay near 1 (uniform scheduling dispatches
+       every shard on the same public cadence);
+    2. **dispatch/fill correlation with offered shard load** — a
+       shard's round activity must not correlate with its own queue
+       depth beyond the declared partition (honest scheduling is
+       unconditional; only a load-gated scheduler correlates);
+    3. **flush-phase alignment** — delayed-eviction flush-per-round
+       rates must match the declared cadence on every shard alike.
+
+    Feeding: ``observe_tick(samples)`` with one aligned sample per
+    shard. A tick with any shard missing (scrape failure) updates the
+    cumulative baselines but contributes no evidence — a degraded
+    fleet accumulates verdicts more slowly instead of falsely.
+
+    Verdict semantics mirror :class:`TranscriptLeakMonitor`: each
+    detector reports statistic, threshold, and sample count; below
+    min-samples reports PASS; overall SUSPECT iff any detector trips.
+    Exports are statistic/threshold/verdict/sample-count only, under
+    the ``grapevine_fleet_*`` namespace with ``shard`` (declared
+    integer indices) as the only label — audited by
+    tools/check_telemetry_policy.py.
+    """
+
+    def __init__(
+        self,
+        n_shards: int,
+        cfg: FleetUniformityConfig | None = None,
+        registry: TelemetryRegistry | None = None,
+    ):
+        if n_shards < 2:
+            raise ValueError("fleet uniformity needs at least 2 shards")
+        self.n_shards = int(n_shards)
+        self.cfg = cfg or FleetUniformityConfig()
+        self._lock = threading.Lock()
+        #: last cumulative (rounds, fill_sum, fill_count, flushes) per
+        #: shard, None until first observed
+        self._base: list = [None] * self.n_shards
+        #: aligned tick window: each entry is (d_rounds, fill_mean,
+        #: d_flushes, queue_depth) arrays over shards
+        self._window: deque = deque(maxlen=self.cfg.window_ticks)
+        self._g_stat = self._g_thr = self._g_suspect = None
+        self._g_rounds = self._g_ticks = None
+        if registry is not None:
+            shards = tuple(str(i) for i in range(self.n_shards))
+            # one unlabeled statistic/threshold pair per detector: the
+            # grapevine_fleet_* namespace permits ONLY the shard label
+            # (tools/check_telemetry_policy.py audit_fleet_registry),
+            # so detector identity lives in the metric name
+            self._g_stat = {}
+            self._g_thr = {}
+            for det, what in (
+                ("cadence_ratio", "pairwise windowed round-count "
+                 "|log ratio| (honest uniform scheduling ~ 0)"),
+                ("fill_load_correlation", "max per-shard Fisher |z| of "
+                 "corr(round activity, own queue depth) — honest "
+                 "unconditional dispatch gives sampling noise"),
+                ("flush_phase", "pairwise flush-per-round rate drift "
+                 "(honest shards all flush at the declared cadence)"),
+            ):
+                self._g_stat[det] = registry.gauge(
+                    f"grapevine_fleet_uniformity_{det}_statistic",
+                    f"cross-shard uniformity detector statistic: {what}")
+                self._g_thr[det] = registry.gauge(
+                    f"grapevine_fleet_uniformity_{det}_threshold",
+                    "effective (scale-aware) threshold for the "
+                    f"{det} detector")
+            self._g_suspect = registry.gauge(
+                "grapevine_fleet_uniformity_suspect",
+                "1 while any cross-shard uniformity detector trips")
+            self._g_rounds = registry.gauge(
+                "grapevine_fleet_uniformity_window_rounds",
+                "per-shard rounds in the current uniformity window "
+                "(cadence/flush detector sample size)",
+                labels={"shard": shards})
+            self._g_ticks = registry.gauge(
+                "grapevine_fleet_uniformity_window_ticks",
+                "aligned fleet ticks in the current uniformity window "
+                "(correlation detector sample size)")
+
+    # -- feeding --------------------------------------------------------
+
+    def observe_tick(self, samples) -> None:
+        """Feed one aligned fleet tick.
+
+        ``samples``: sequence of length ``n_shards``; each element is a
+        dict with cumulative ``rounds_total``, ``flushes_total``,
+        optional cumulative ``fill_sum``/``fill_count``, and
+        instantaneous ``queue_depth`` — or None for a shard whose
+        scrape failed this tick."""
+        if len(samples) != self.n_shards:
+            raise ValueError(
+                f"tick has {len(samples)} samples for {self.n_shards} shards"
+            )
+        with self._lock:
+            complete = all(s is not None for s in samples)
+            d_rounds = np.zeros(self.n_shards)
+            fill_mean = np.zeros(self.n_shards)
+            d_flush = np.zeros(self.n_shards)
+            qdepth = np.zeros(self.n_shards)
+            for i, s in enumerate(samples):
+                if s is None:
+                    continue
+                cur = (
+                    float(s["rounds_total"]),
+                    float(s.get("fill_sum", 0.0)),
+                    float(s.get("fill_count", 0.0)),
+                    float(s.get("flushes_total", 0.0)),
+                )
+                base = self._base[i]
+                self._base[i] = cur
+                if base is None:
+                    complete = False  # first sight: no delta yet
+                    continue
+                # counters only go up; a reset (member restart) would
+                # produce a negative delta — clamp and treat the tick
+                # as evidence-free for that shard
+                dr = cur[0] - base[0]
+                if dr < 0:
+                    complete = False
+                    continue
+                d_rounds[i] = dr
+                dfc = cur[2] - base[2]
+                fill_mean[i] = (
+                    (cur[1] - base[1]) / dfc if dfc > 0 else 0.0
+                )
+                d_flush[i] = max(0.0, cur[3] - base[3])
+                qdepth[i] = float(s.get("queue_depth", 0.0))
+            if complete:
+                self._window.append((d_rounds, fill_mean, d_flush, qdepth))
+            self._export_locked()
+
+    def _export_locked(self) -> None:
+        if self._g_rounds is None:
+            return
+        rounds = self._rounds_locked()
+        for i in range(self.n_shards):
+            self._g_rounds.set(float(rounds[i]), shard=str(i))
+        self._g_ticks.set(float(len(self._window)))
+
+    def _rounds_locked(self) -> np.ndarray:
+        if not self._window:
+            return np.zeros(self.n_shards)
+        return np.sum([w[0] for w in self._window], axis=0)
+
+    # -- judging --------------------------------------------------------
+
+    def verdict(self) -> dict:
+        """Machine-readable fleet uniformity verdict, in the
+        TranscriptLeakMonitor detector-dict shape (folded into the
+        fleet /leakaudit body by obs/fleet.py)."""
+        cfg = self.cfg
+        with self._lock:
+            ticks = len(self._window)
+            if ticks:
+                d_rounds = np.stack([w[0] for w in self._window])
+                d_flush = np.stack([w[2] for w in self._window])
+                qdepth = np.stack([w[3] for w in self._window])
+            else:
+                d_rounds = d_flush = qdepth = np.zeros((0, self.n_shards))
+        R = d_rounds.sum(axis=0)  # per-shard rounds in window
+        F = d_flush.sum(axis=0)
+        detectors = []
+
+        # 1. pairwise cadence-ratio drift (max over pairs)
+        worst = (0, 1, 0.0, cfg.cadence_ratio_floor)
+        for a in range(self.n_shards):
+            for b in range(a + 1, self.n_shards):
+                stat = abs(math.log((R[a] + 0.5) / (R[b] + 0.5)))
+                thr = max(
+                    cfg.cadence_ratio_floor,
+                    cfg.rate_z_margin * math.sqrt(
+                        1.0 / (R[a] + 0.5) + 1.0 / (R[b] + 0.5)),
+                )
+                # rank pairs by threshold exceedance, not raw drift — a
+                # low-evidence pair with a big ratio must not outrank a
+                # well-evidenced drifting pair
+                if stat - thr > worst[2] - worst[3]:
+                    worst = (a, b, stat, thr)
+        a, b, stat, thr = worst
+        samples = int(min(R[a], R[b])) if ticks else 0
+        detectors.append({
+            "name": "cadence_ratio",
+            "pair": [a, b],
+            "statistic": round(stat, 4),
+            "threshold": round(thr, 4),
+            "samples": samples,
+            "min_samples": cfg.min_rounds,
+            "verdict": SUSPECT if (
+                samples >= cfg.min_rounds and stat > thr
+            ) else PASS,
+        })
+
+        # 2. per-shard dispatch/load correlation (max Fisher |z|)
+        worst_s, worst_z = 0, 0.0
+        for s in range(self.n_shards):
+            z = self._fisher_z(d_rounds[:, s], qdepth[:, s])
+            if z > worst_z:
+                worst_s, worst_z = s, z
+        detectors.append({
+            "name": "fill_load_correlation",
+            "shard": worst_s,
+            "statistic": round(worst_z, 3),
+            "threshold": cfg.corr_z_threshold,
+            "samples": ticks,
+            "min_samples": cfg.min_ticks,
+            "verdict": SUSPECT if (
+                ticks >= cfg.min_ticks and worst_z > cfg.corr_z_threshold
+            ) else PASS,
+        })
+
+        # 3. pairwise flush-per-round rate drift
+        f = (F + 0.5) / (R + 1.0)
+        fa, fb = (int(np.argmax(f)), int(np.argmin(f)))
+        stat = float(f[fa] - f[fb])
+        fbar = min(max(float(np.mean(f)), 1e-6), 1.0 - 1e-6)
+        samples = int(min(R[fa], R[fb])) if ticks else 0
+        thr = max(
+            cfg.flush_rate_floor,
+            cfg.rate_z_margin * math.sqrt(
+                fbar * (1.0 - fbar)
+                * (1.0 / (R[fa] + 1.0) + 1.0 / (R[fb] + 1.0))),
+        )
+        detectors.append({
+            "name": "flush_phase",
+            "pair": [fa, fb],
+            "statistic": round(stat, 4),
+            "threshold": round(thr, 4),
+            "samples": samples,
+            "min_samples": cfg.min_rounds,
+            "verdict": SUSPECT if (
+                samples >= cfg.min_rounds and stat > thr
+            ) else PASS,
+        })
+
+        overall = SUSPECT if any(
+            d["verdict"] == SUSPECT for d in detectors) else PASS
+        if self._g_stat is not None:
+            for d in detectors:
+                self._g_stat[d["name"]].set(float(d["statistic"]))
+                self._g_thr[d["name"]].set(float(d["threshold"]))
+            self._g_suspect.set(1.0 if overall == SUSPECT else 0.0)
+        return {
+            "verdict": overall,
+            "n_shards": self.n_shards,
+            "window_ticks": ticks,
+            "detectors": detectors,
+        }
+
+    @staticmethod
+    def _fisher_z(x: np.ndarray, y: np.ndarray) -> float:
+        """|Fisher z| of the Pearson correlation; 0 when either series
+        is constant (an unconditionally-dispatching shard has zero
+        round-count variance — the honest case, by construction)."""
+        n = len(x)
+        if n < 4 or float(np.std(x)) == 0.0 or float(np.std(y)) == 0.0:
+            return 0.0
+        r = float(np.corrcoef(x, y)[0, 1])
+        if not math.isfinite(r):
+            return 0.0
+        r = max(-0.999999, min(0.999999, r))
+        return abs(math.atanh(r)) * math.sqrt(n - 3)

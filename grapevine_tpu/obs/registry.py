@@ -42,6 +42,9 @@ ALLOWED_LABEL_KEYS = frozenset({
     "tree",    # which ORAM ("rec" / "mb") — structural, not data
     "role",    # serving role ("mono" / "engine" / "frontend")
     "result",  # coarse outcome bucket ("ok" / "error")
+    "shard",   # fleet shard index — declared small-integer topology
+               # positions only (obs/fleet.py); never a member name,
+               # address, or anything derived from traffic
 })
 
 #: Known-dangerous keys, named so the registration error can say *why*.
@@ -85,6 +88,19 @@ def _check_labels(name: str, labels: dict[str, tuple[str, ...]] | None):
                 "— label values must be enumerated at registration "
                 "(dynamic values are how identities leak into series)"
             )
+        if key == "shard":
+            # shard identity is public topology (a config-declared
+            # position), and ONLY that: integer indices. A hostname,
+            # address, or pod name as a shard value would export
+            # deployment identity through every fleet series.
+            for v in values:
+                if not v.isascii() or not v.isdigit():
+                    raise TelemetryLeakError(
+                        f"metric {name!r}: shard label value {v!r} is "
+                        "not a bare integer index — shard values are "
+                        "declared topology positions (0..N-1), never "
+                        "member names or addresses (obs/fleet.py)"
+                    )
         out[key] = values
     return out
 

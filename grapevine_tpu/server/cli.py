@@ -140,13 +140,38 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--role",
-        choices=["mono", "engine", "frontend"],
+        choices=["mono", "engine", "frontend", "fleet"],
         default="mono",
         help="mono = engine + sessions in one process (default); "
         "engine = device engine tier only (serves the internal Submit "
         "API on --engine-listen); frontend = client-facing session "
         "process forwarding validated ops to --engine (run N of these "
-        "behind a load balancer — server/tier.py)",
+        "behind a load balancer — server/tier.py); fleet = scrape "
+        "aggregator over N member processes' metrics endpoints, "
+        "serving merged shard-labeled /metrics, /healthz, /leakaudit "
+        "with cross-shard uniformity detectors (obs/fleet.py)",
+    )
+    p.add_argument(
+        "--fleet-members",
+        help="(role=fleet) comma-separated member metrics endpoints as "
+        "host:port; list POSITION is the shard index — the only member "
+        "identity that ever reaches a metric label (obs/fleet.py)",
+    )
+    p.add_argument(
+        "--fleet-scrape-interval",
+        type=float,
+        default=1.0,
+        help="(role=fleet) seconds between scrape cycles. With the "
+        "start instant this fixes the ENTIRE scrape schedule — a pure "
+        "function of config, never of observed traffic "
+        "(OPERATIONS.md §20)",
+    )
+    p.add_argument(
+        "--fleet-port",
+        type=int,
+        default=0,
+        help="(role=fleet) port for the merged fleet endpoints "
+        "(0 = ephemeral); binds --metrics-host",
     )
     p.add_argument(
         "--engine-listen",
@@ -330,6 +355,12 @@ _ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels",
                       "pipeline_depth", "evict_every",
                       "evict_buffer_slots"}
 
+#: fleet-aggregator topology/cadence: only the fleet role scrapes —
+#: any other role supplied --fleet-members would silently aggregate
+#: nothing, and a fleet role supplied engine flags would silently
+#: serve no engine
+_FLEET_FLAGS = {"fleet_members", "fleet_scrape_interval", "fleet_port"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
@@ -345,6 +376,10 @@ _ROLE_FLAGS = {
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
+    # the fleet role owns no device, no listener, no sessions: it
+    # scrapes declared members and serves the merged view — the only
+    # non-fleet flag it takes is the bind interface
+    "fleet": {"role", "verbose", "metrics_host"} | _FLEET_FLAGS,
 }
 
 
@@ -467,6 +502,34 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"--identity-seed must be 64 hex chars (32 bytes): {exc}"
             ) from None
+    if args.role == "fleet":
+        import threading
+
+        from ..obs.fleet import FleetAggregator, FleetConfig
+
+        if not args.fleet_members:
+            raise SystemExit(
+                "--role fleet requires --fleet-members host:port,..."
+            )
+        members = tuple(
+            m.strip() for m in args.fleet_members.split(",") if m.strip()
+        )
+        agg = FleetAggregator(FleetConfig(
+            members=members,
+            scrape_interval_s=args.fleet_scrape_interval,
+        ))
+        fport = agg.serve(args.fleet_port, host=args.metrics_host)
+        print(f"grapevine-tpu fleet aggregator on port {fport} "
+              f"({len(members)} members)", flush=True)
+        # the aggregator holds no engine state: drain = stop scraping
+        # and close the endpoint
+        _install_drain_handlers(agg.stop)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:  # pragma: no cover - handler owns it
+            agg.stop()
+        return 0
+
     if args.role == "engine":
         import threading
 

@@ -352,7 +352,10 @@ class GrapevineServer:
         design — this must answer while a stuck round holds the engine
         lock."""
         healthy = True
-        detail: dict = {}
+        # role tag: the fleet aggregator (obs/fleet.py) folds member
+        # healthz docs and needs to tell tiers apart by body alone
+        detail: dict = {"role": "frontend" if self.engine is None
+                        else "mono"}
         sched = self.scheduler
         if hasattr(sched, "worker_alive"):  # injected stubs may lack it
             alive = sched.worker_alive()

@@ -186,6 +186,9 @@ class EngineServer:
         age = self.engine.metrics.last_round_age()
         healthy = alive and stall < stall_threshold
         detail = {
+            # role tag: the fleet aggregator (obs/fleet.py) folds member
+            # healthz docs and needs to tell tiers apart by body alone
+            "role": "engine",
             "worker_alive": alive,
             "stall_age_s": round(stall, 3),
             "last_round_age_s": None if age is None else round(age, 3),

@@ -47,6 +47,27 @@ def _check(argv):
     ["--role", "frontend", "--evict-every", "4"],
     ["--role", "frontend", "--evict-every", "1"],
     ["--role", "frontend", "--evict-buffer-slots", "4096"],
+    # fleet topology/cadence belongs to the fleet role alone (ISSUE 16
+    # satellite): any other role supplying --fleet-* would silently
+    # aggregate nothing — rejected even at default values
+    ["--role", "mono", "--fleet-members", "h0:1,h1:1"],
+    ["--role", "engine", "--fleet-members", "h0:1"],
+    ["--role", "frontend", "--fleet-members", "h0:1"],
+    ["--role", "mono", "--fleet-scrape-interval", "1.0"],
+    ["--role", "engine", "--fleet-scrape-interval", "0.5"],
+    ["--role", "frontend", "--fleet-port", "0"],
+    ["--role", "engine", "--fleet-port", "9500"],
+    # ...and the fleet role owns no device, listener, or sessions: it
+    # rejects engine/frontend/mono flags, even at default values
+    ["--role", "fleet", "--fleet-members", "h0:1", "--batch-size", "8"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--listen",
+     "insecure-grapevine://0.0.0.0:3229"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--state-dir", "/x"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--leakmon"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--engine", "x:1"],
+    ["--role", "fleet", "--fleet-members", "h0:1",
+     "--metrics-port", "9464"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--seed", "0"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -91,6 +112,13 @@ def test_misapplied_flags_rejected(argv):
      "--evict-every", "1"],
     ["--role", "mono", "--evict-every", "4",
      "--evict-buffer-slots", "4096"],
+    # the fleet role takes its topology/cadence flags + the bind
+    # interface (ISSUE 16)
+    ["--role", "fleet", "--fleet-members", "127.0.0.1:9464,127.0.0.1:9465"],
+    ["--role", "fleet", "--fleet-members", "h0:1,h1:1",
+     "--fleet-scrape-interval", "0.25", "--fleet-port", "0"],
+    ["--role", "fleet", "--fleet-members", "h0:1",
+     "--metrics-host", "127.0.0.1", "-v"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

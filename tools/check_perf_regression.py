@@ -64,7 +64,7 @@ LOWER_BETTER = ("_ms", "_ms_per_op", "_s")
 GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
                  "rounds", "slo_target_ms", "pipeline_depth",
-                 "evict_every")
+                 "evict_every", "shard_count")
 
 #: result fields that are neither geometry nor a directional metric.
 #: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
@@ -306,6 +306,28 @@ def selftest(factor: float) -> None:
     regs, n = compare_latest(extract_series([c, d]), factor)
     assert n == 3 and len(regs) == 3, (
         f"sentinel self-test: same-E series not gated ({n=}, {regs})"
+    )
+    # shard_count is GEOMETRY (PR 16, bench fleet_loopback): an N=2
+    # fleet capacity line sums two shard knees over two engines — a
+    # different deployment shape whose numbers must never grade against
+    # the N=1 (monolithic) series, in either direction; same-N fleet
+    # lines must still gate each other.
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    b["configs"]["load_scenarios"]["shard_count"] = 2
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: a shard_count-keyed fleet line was "
+        "compared against the single-process baseline"
+    )
+    e = mk_cap(200.0, 40.0, 3250.7)
+    f = mk_cap(200.0 / (factor * 4.0), 40.0 * factor * 4.0, 3250.7)
+    e["configs"]["load_scenarios"]["shard_count"] = 2
+    f["configs"]["load_scenarios"]["shard_count"] = 2
+    regs, n = compare_latest(extract_series([e, f]), factor)
+    assert n == 3 and len(regs) == 3, (
+        f"sentinel self-test: same-shard-count series not gated "
+        f"({n=}, {regs})"
     )
 
 

@@ -365,6 +365,93 @@ def audit_evict_registry() -> dict:
     return report
 
 
+def audit_fleet_registry() -> dict:
+    """Runtime pass over the fleet observatory's metric namespace
+    (ISSUE-16 satellite — the ``grapevine_fleet_*`` families the
+    aggregator and the cross-shard uniformity monitor register):
+
+    - ``shard`` is the ONLY label key anywhere in the namespace, and
+      every declared value is a bare integer index (position in the
+      declared member list — public topology; a member NAME or
+      ADDRESS in a label value would export deployment identity);
+    - the uniformity detectors export statistic/threshold/verdict
+      scalars only — label-free pairs per detector, no per-shard
+      payload-derived fields (the per-shard series the detectors
+      consume stay inside the monitor);
+    - teeth: registering a member-name or address label value under
+      ``shard``, or a ``member`` label key, raises TelemetryLeakError
+      at registration — the integer-index rule is enforcement, not
+      convention.
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.obs.fleet import FleetAggregator, FleetConfig
+    from grapevine_tpu.obs.registry import (
+        TelemetryLeakError,
+        TelemetryRegistry,
+    )
+
+    agg = FleetAggregator(FleetConfig(members=("h0:1", "h1:1", "h2:1")))
+    report = agg.registry.audit()  # raises on any violation
+
+    families = [
+        m for m in agg.registry.collect()
+        if m.name.startswith("grapevine_fleet_")
+    ]
+    if len(families) < 8:
+        raise SystemExit(
+            "fleet namespace missing: aggregator registered only "
+            f"{[m.name for m in families]}"
+        )
+    for m in families:
+        bad = set(m.label_keys) - {"shard"}
+        if bad:
+            raise SystemExit(
+                f"fleet metric {m.name!r} carries label keys "
+                f"{sorted(bad)} — 'shard' is the only permitted key "
+                "in the grapevine_fleet_* namespace"
+            )
+        for v in m.labels_decl.get("shard", ()):
+            if not (v.isascii() and v.isdigit()):
+                raise SystemExit(
+                    f"fleet metric {m.name!r} declares shard value "
+                    f"{v!r} — values must be bare integer indices"
+                )
+    # the uniformity detector exports: statistic/threshold pairs per
+    # detector plus the verdict gauge, all label-free scalars
+    for det in ("cadence_ratio", "fill_load_correlation", "flush_phase"):
+        for kind in ("statistic", "threshold"):
+            name = f"grapevine_fleet_uniformity_{det}_{kind}"
+            m = agg.registry.get(name)
+            if m is None:
+                raise SystemExit(f"uniformity export {name!r} missing")
+            if m.label_keys:
+                raise SystemExit(
+                    f"uniformity export {name!r} carries label keys "
+                    f"{list(m.label_keys)} — detector exports are "
+                    "label-free scalars by policy"
+                )
+    if agg.registry.get("grapevine_fleet_uniformity_suspect") is None:
+        raise SystemExit("uniformity verdict gauge missing")
+
+    # teeth: member identity can never ride a label
+    r = TelemetryRegistry()
+    for labels, why in (
+        ({"shard": ("engine-a.internal",)}, "member-name shard value"),
+        ({"shard": ("10.0.0.7:9464",)}, "address shard value"),
+        ({"member": ("0",)}, "'member' label key"),
+    ):
+        try:
+            r.gauge("grapevine_fleet_teeth_probe", "probe", labels=labels)
+        except TelemetryLeakError:
+            continue
+        raise SystemExit(
+            f"fleet label policy has no teeth: {why} was accepted at "
+            "registration"
+        )
+    report["fleet_families"] = len(families)
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
@@ -374,6 +461,7 @@ def main() -> int:
     ts_report = audit_trace_slo_registry()
     wl_report = audit_workload_registry()
     audit_evict_registry()
+    fl_report = audit_fleet_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
@@ -383,7 +471,9 @@ def main() -> int:
         f"ok ({ts_report['trace_slo_families']} families, ring schema "
         f"enforced); workload audit ok ({wl_report['workload_families']} "
         "families, fixed buckets, depth-field teeth); evict audit ok "
-        "(label-free buffer canaries, flush phase declared, teeth)"
+        "(label-free buffer canaries, flush phase declared, teeth); "
+        f"fleet audit ok ({fl_report['fleet_families']} families, "
+        "shard-only integer labels, teeth)"
     )
     return 1 if violations else 0
 
