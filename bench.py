@@ -533,6 +533,26 @@ def _vphases_machinery_sweep(smoke):
     return res
 
 
+def _model_ab(kind, measured, **kw):
+    """Modeled-vs-measured winner line for one A/B config group.
+
+    Every ``_ab`` bench reports the static cost model's pick
+    (analysis/costmodel.ab_verdict — amortized HBM bytes at the exact
+    bench geometry, tie-band preferring least machinery) next to the
+    measured winner, so a model/machine divergence is visible in the
+    bench output itself, not only in the post-hoc
+    ``check_cost_model --grade`` replay of the banked trajectory."""
+    from grapevine_tpu.analysis.costmodel import ab_verdict
+
+    v = ab_verdict(kind, **kw)
+    return {
+        "modeled_winner": v["winner"],
+        "measured_winner": measured,
+        "agree": v["winner"] == measured,
+        "basis": v["basis"],
+    }
+
+
 def _min_of(fn, args, reps):
     """Interleaved-A/B timing primitive shared by the `_ab` configs:
     min of ``reps`` timed calls after one compile+warm call — the min
@@ -685,6 +705,18 @@ def bench_sort_ab(smoke):
             ),
             "speedup_radix_over_xla": round(mx / mr, 3),
         }
+
+    # modeled-vs-measured winner per config group (ISSUE 17): sort is
+    # a structural verdict — backend decides (serial scatter floor on
+    # XLA:CPU), not a byte count — so one verdict covers every group
+    backend = jax.default_backend()
+    for scope in ("machinery", "sweep"):
+        for g in out[scope].values():
+            g["model"] = _model_ab(
+                "sort",
+                "radix" if g["speedup_radix_over_xla"] > 1.0 else "xla",
+                scope=scope, backend=backend,
+            )
     return out
 
 
@@ -945,6 +977,13 @@ def bench_tree_cache_ab(smoke):
                 grid[f"k{k}"]["speedup_over_k0"] = round(
                     base / grid[f"k{k}"]["round_ms"], 3
                 )
+            grid["model"] = _model_ab(
+                "tree_cache",
+                min((f"k{k}" for k in ks_m),
+                    key=lambda a: grid[a]["round_ms"]),
+                scope="machinery", cap_n=cap_n, batch=b,
+                arms=list(ks_m),
+            )
             out["machinery"][f"round_cap{cap_n}_b{b}"] = grid
 
     # --- whole round: tree_top_cache_levels the only knob --------------
@@ -993,6 +1032,13 @@ def bench_tree_cache_ab(smoke):
             }
             if k == "auto":
                 entry["kauto"]["resolved_k"] = ctxs[k][0].tree_top_cache_levels
+        numeric = [k for k in ks if k != "auto"]
+        entry["model"] = _model_ab(
+            "tree_cache",
+            min((f"k{k}" for k in numeric),
+                key=lambda a: entry[a]["round_ms"]),
+            scope="sweep", batch=B, arms=numeric,
+        )
         out["sweep"][str(B)] = entry
     return out
 
@@ -1121,6 +1167,13 @@ def bench_evict_ab(smoke):
                 g["fetch_fraction_of_e1"] = round(
                     g["fetch_round_ms"] / base, 3
                 )
+            grid["model"] = _model_ab(
+                "evict",
+                min((f"e{e}" for e in es_m),
+                    key=lambda a: grid[a]["amortized_round_ms"]),
+                scope="machinery", cap_n=cap_n, batch=b,
+                arms=list(es_m),
+            )
             out["machinery"][f"round_cap{cap_n}_b{b}"] = grid
 
     # --- whole round: evict_every the only knob ------------------------
@@ -1198,6 +1251,12 @@ def bench_evict_ab(smoke):
                 for t in ("rec", "mb")
             )
             assert ov == 0, f"overflow at E={e}: {ov}"
+        entry["model"] = _model_ab(
+            "evict",
+            min((f"e{e}" for e in es),
+                key=lambda a: entry[a]["amortized_round_ms"]),
+            scope="sweep", batch=B, arms=list(es),
+        )
         out["sweep"][str(B)] = entry
     return out
 
@@ -1736,6 +1795,11 @@ def bench_pipeline_ab(smoke):
             d2["ops_per_sec"] / d1["ops_per_sec"], 3)
         out["p99_delta_ms_d1_minus_d2"] = round(
             d1["p99_commit_ms"] - d2["p99_commit_ms"], 2)
+        out["model"] = _model_ab(
+            "pipeline",
+            "depth2" if d2["ops_per_sec"] > d1["ops_per_sec"]
+            else "depth1",
+        )
         return out
     finally:
         for arm in arms.values():

@@ -92,6 +92,8 @@ LOCK_ALLOW: tuple = (
               "attach-before-serve single reference assignment"),
     LockAllow("GrapevineEngine", "workload",
               "attach-before-serve single reference assignment"),
+    LockAllow("GrapevineEngine", "costmon",
+              "attach-before-serve single reference assignment"),
     LockAllow("GrapevineEngine", "_replay_since",
               "recovery-only scratch (the replay cadence audit): "
               "written exclusively inside __init__'s single-threaded "

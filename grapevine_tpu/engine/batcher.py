@@ -236,6 +236,11 @@ class PendingRound:
             # from this round's span ledger (obs/workload.py) — a few
             # histogram/gauge samples on the collector thread
             wl.observe_round(self._n, bs, self._qdepth, spans)
+        cmn = getattr(self._engine, "costmon", None)
+        if cmn is not None:
+            # device span vs the modeled roofline floor (obs/costmon.py)
+            # — two gauge sets per round
+            cmn.observe_round(spans)
         lm = self._engine.leakmon
         if lm is not None and self._transcript is not None:
             # one non-blocking queue put; detectors run on the monitor's
@@ -328,6 +333,10 @@ class GrapevineEngine:
         #: depth / arrival-rate / utilization signals, attached by the
         #: serving layer or the load harness; None = not sampled
         self.workload = None
+        #: cost observatory (obs/costmon.py): static grapevine_cost_*
+        #: ledger gauges plus the per-round roofline residual, attached
+        #: by the serving layer; None = rounds are not scored
+        self.costmon = None
         #: crash safety (engine/checkpoint.py): with a DurabilityConfig,
         #: every admitted batch is journaled before dispatch and the
         #: whole state checkpointed every N records; construction runs
@@ -486,6 +495,11 @@ class GrapevineEngine:
         """Attach a WorkloadTelemetry; subsequent rounds observe their
         fill/backlog/utilization and the scheduler notes arrivals."""
         self.workload = workload
+
+    def attach_costmon(self, costmon) -> None:
+        """Attach a CostMonitor; subsequent rounds score their device
+        span against the modeled roofline floor."""
+        self.costmon = costmon
 
     def calibrate_sort_phase(self, reps: int = 5) -> float:
         """Measure the round's bounded-key sort workload standalone and

@@ -71,6 +71,7 @@ from .tracer import RoundTracer  # noqa: F401
 from .slo import SloConfig, SloTracker  # noqa: F401
 from .profiler import ProfilerBusy, ProfilerGate  # noqa: F401
 from .workload import WorkloadTelemetry  # noqa: F401
+from .costmon import CostMonitor  # noqa: F401
 
 
 def attach_round_observability(engine, registry, *, trace_ring_size=512,
@@ -104,4 +105,9 @@ def attach_round_observability(engine, registry, *, trace_ring_size=512,
     engine.attach_workload(
         WorkloadTelemetry(registry, batch_size=engine.ecfg.batch_size)
     )
+    # the cost observatory (obs/costmon.py): the static grapevine_cost_*
+    # ledger (pure geometry x knobs — the bit-exact model the
+    # check_cost_model gate cross-validates) plus the per-round
+    # roofline residual against the tracer's device span
+    engine.attach_costmon(CostMonitor(engine.ecfg, registry))
     return tracer, slo_tracker, ProfilerGate() if profile_enable else None

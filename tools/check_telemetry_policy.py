@@ -452,6 +452,98 @@ def audit_fleet_registry() -> dict:
     return report
 
 
+def audit_cost_registry() -> dict:
+    """Runtime pass over the cost observatory's metric namespace.
+
+    Builds the registry exactly as ``attach_round_observability`` does
+    (a CostMonitor over a real EngineConfig) and asserts, beyond the
+    generic ``audit()``:
+
+    - the ``grapevine_cost_*`` families exist (the ledger is actually
+      exporting: per-phase bytes/rows/cipher/sort, the steady-state
+      total, the calibrated bandwidth, the roofline floor + residual);
+    - ``phase`` is the only label key in the namespace, and its
+      declared values are exactly the model's fixed schedule names
+      (:data:`costmodel.COST_PHASES`) — public program structure.
+      Geometry belongs in gauge VALUES (which any observer could
+      derive from the config), never in label sets;
+    - teeth: a geometry-shaped label key (``capacity``/``geometry``)
+      or a geometry value smuggled into ``phase`` raises
+      TelemetryLeakError at registration — the allowlist plus the
+      fixed-phase rule are enforcement, not convention.
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.analysis.costmodel import COST_PHASES
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.state import EngineConfig
+    from grapevine_tpu.obs.costmon import CostMonitor
+    from grapevine_tpu.obs.registry import (
+        TelemetryLeakError,
+        TelemetryRegistry,
+    )
+
+    reg = TelemetryRegistry()
+    ecfg = EngineConfig.from_config(GrapevineConfig(
+        max_messages=1 << 10, max_recipients=1 << 7, batch_size=8,
+    ))
+    CostMonitor(ecfg, reg, bandwidth_gbps=8.0)
+    report = reg.audit()  # raises on any violation
+
+    families = [
+        m for m in reg.collect() if m.name.startswith("grapevine_cost_")
+    ]
+    if len(families) < 9:
+        raise SystemExit(
+            "cost namespace missing: CostMonitor registered only "
+            f"{[m.name for m in families]}"
+        )
+    for m in families:
+        bad = set(m.label_keys) - {"phase"}
+        if bad:
+            raise SystemExit(
+                f"cost metric {m.name!r} carries label keys "
+                f"{sorted(bad)} — 'phase' is the only permitted key "
+                "in the grapevine_cost_* namespace"
+            )
+        for v in m.labels_decl.get("phase", ()):
+            if v not in COST_PHASES:
+                raise SystemExit(
+                    f"cost metric {m.name!r} declares phase value "
+                    f"{v!r} — values must be the fixed schedule names "
+                    f"{COST_PHASES}, never geometry"
+                )
+    for name in ("grapevine_cost_roofline_residual",
+                 "grapevine_cost_roofline_floor_ms",
+                 "grapevine_cost_steady_round_hbm_bytes"):
+        m = reg.get(name)
+        if m is None:
+            raise SystemExit(f"cost export {name!r} missing")
+        if m.label_keys:
+            raise SystemExit(
+                f"cost export {name!r} carries label keys "
+                f"{list(m.label_keys)} — roofline exports are "
+                "label-free scalars by policy"
+            )
+
+    # teeth: geometry can never ride a label in this namespace
+    r = TelemetryRegistry()
+    for labels, why in (
+        ({"capacity": ("65536",)}, "geometry-value 'capacity' label key"),
+        ({"geometry": ("h14_z4",)}, "'geometry' label key"),
+        ({"leaf": ("12",)}, "'leaf' label key"),
+    ):
+        try:
+            r.gauge("grapevine_cost_teeth_probe", "probe", labels=labels)
+        except TelemetryLeakError:
+            continue
+        raise SystemExit(
+            f"cost label policy has no teeth: {why} was accepted at "
+            "registration"
+        )
+    report["cost_families"] = len(families)
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
@@ -462,6 +554,7 @@ def main() -> int:
     wl_report = audit_workload_registry()
     audit_evict_registry()
     fl_report = audit_fleet_registry()
+    cost_report = audit_cost_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
@@ -473,7 +566,9 @@ def main() -> int:
         "families, fixed buckets, depth-field teeth); evict audit ok "
         "(label-free buffer canaries, flush phase declared, teeth); "
         f"fleet audit ok ({fl_report['fleet_families']} families, "
-        "shard-only integer labels, teeth)"
+        "shard-only integer labels, teeth); cost audit ok "
+        f"({cost_report['cost_families']} families, phase-only labels, "
+        "fixed schedule values, teeth)"
     )
     return 1 if violations else 0
 

@@ -837,6 +837,105 @@ def stage_evict_perf(cap, args):
     cap.emit("evict_perf", **out)
 
 
+def stage_cost_calibrate(cap, args):
+    """Fit the cost observatory's achieved-bandwidth constant on real
+    silicon and pre-rank the deferred ``auto`` knob decisions (PR 17).
+
+    The static ledger (analysis/costmodel.py — cross-validated
+    bit-exactly against the traced census by check_cost_model) prices
+    a steady-state round in HBM bytes; this stage closes the loop with
+    the one free parameter. A steady stream runs through the
+    production scheduler with the full round observability attached
+    (tracer + costmon — the same wiring a serving role gets), and the
+    fit is
+
+        achieved GB/s = modeled steady-round bytes / device span
+
+    over the per-round host-observed device spans. The fitted constant
+    is what operators export as ``GRAPEVINE_COST_GBPS``
+    (obs/costmon.py resolution order) so the /metrics roofline
+    residual reads ~1.0 on a healthy round instead of
+    placeholder-shifted; residual spread (p10/p90 over the same spans)
+    is banked so drift alerts can be sized to real round-to-round
+    jitter. Alongside the fit, the model's verdict for every deferred
+    ``auto`` knob is pre-ranked at BOTH scopes of the capture geometry
+    — the record carries the predictions next to the measured stage
+    results (sort_perf / tree_cache_perf / evict_perf /
+    pipeline_perf) that grade them on-chip."""
+    import jax
+    import numpy as np
+
+    from grapevine_tpu.analysis import costmodel as cm
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ScenarioRunner,
+        calibrate_unloaded_round,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs import attach_round_observability
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    cl, b, dur = (14, 16, 4.0) if args.quick else (18, 256, 10.0)
+    cfg = GrapevineConfig(
+        max_messages=1 << cl, max_recipients=1 << 10, batch_size=b,
+    )
+    engine = GrapevineEngine(cfg)
+    tracer, _, _ = attach_round_observability(
+        engine, engine.metrics.registry)
+    _, est, _ = calibrate_unloaded_round(engine, 1_700_000_000)
+    sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+    try:
+        runner = ScenarioRunner(sched, n_idents=64,
+                                settle_timeout_s=180.0)
+        runner.run(steady_poisson(0.6 * est, dur, seed=37))
+    finally:
+        sched.close()
+        engine.close()
+
+    ledger = engine.costmon.ledger
+    dev_ms = np.asarray(tracer.span_durations_ms("device"), dtype=float)
+    dev_ms = dev_ms[dev_ms > 0.0]
+    out = {
+        "capacity_log2": cl, "batch": b,
+        "backend": jax.default_backend(),
+        "modeled_steady_round_bytes": int(ledger.steady_round_bytes),
+        "rounds_fit": int(dev_ms.size),
+        "placeholder_gbps": engine.costmon.bandwidth_gbps,
+    }
+    if dev_ms.size:
+        med = float(np.median(dev_ms))
+        fitted = ledger.steady_round_bytes / (med * 1e6)  # GB/s
+        floor = ledger.floor_ms(fitted)
+        out.update(
+            fitted_gbps=round(fitted, 3),
+            device_span_ms_p50=round(med, 3),
+            floor_ms_at_fit=round(floor, 3),
+            # spread of measured/floor at the fit — p50 is 1.0 by
+            # construction; p10/p90 size the drift-alert band
+            residual_p10=round(
+                float(np.percentile(dev_ms, 10)) / med, 3),
+            residual_p90=round(
+                float(np.percentile(dev_ms, 90)) / med, 3),
+        )
+    # pre-ranked deferred auto-knob decisions at the capture geometry
+    knobs = {}
+    for kind in ("sort", "tree_cache", "evict", "pipeline"):
+        per_scope = {}
+        for scope in (("machinery", "sweep") if kind in
+                      ("tree_cache", "evict", "sort") else ("machinery",)):
+            v = cm.ab_verdict(kind, scope=scope, cap_n=1 << cl,
+                              batch=b, backend=out["backend"])
+            per_scope[scope] = {
+                "winner": v["winner"],
+                "arms": {a: d.get("modeled_bytes")
+                         for a, d in v["arms"].items()},
+            }
+        knobs[kind] = per_scope
+    out["auto_knob_rank"] = knobs
+    cap.emit("cost_calibrate", **out)
+
+
 STAGES = [
     ("probe", stage_probe, 420),
     ("headline", stage_headline, 1500),
@@ -862,6 +961,11 @@ STAGES = [
     # the E A/B + flush-overlap bubble is the ROADMAP-item-1 decision
     # number that settles the evict_every auto (PR 15)
     ("evict_perf", stage_evict_perf, 1200),
+    # cost_calibrate right after the decision stages it pre-ranks:
+    # same geometry family (cached compiles), and the fitted
+    # GRAPEVINE_COST_GBPS constant turns the /metrics roofline
+    # residual from placeholder-shifted into ~1.0-on-healthy (PR 17)
+    ("cost_calibrate", stage_cost_calibrate, 900),
     ("pallas_perf", stage_pallas_perf, 1800),
     ("vphases_perf", stage_vphases_perf, 1800),
     ("sort_perf", stage_sort_perf, 1800),
