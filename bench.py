@@ -1341,6 +1341,131 @@ def bench_sharded(smoke):
             "batch": batch, "capacity_log2": cap.bit_length() - 1, "mesh": n_dev}
 
 
+def bench_sharded_evict_ab(smoke):
+    """Config 5b: owner-masked sharded flush A/B (ISSUE 18; ROADMAP
+    item 1) — delayed batched eviction composed with the bucket-axis
+    mesh. One records-shaped ORAM (the evict_ab machinery geometry,
+    cipher ON, built via costmodel.machinery_oram_cfg so the model
+    prices exactly what is timed) runs sharded per arm over
+    E∈{1,2,4} × shards∈{1,2,4}. Per (s, E>1) arm the fetch-only round
+    and the owner-masked flush are timed as separate jitted shard_map
+    programs (the evict_ab component methodology — an unrolled window
+    in one jit pays an O(E·B) compile without changing what is
+    measured) and amortized as fetch + flush/E.
+    ``fetch_fraction_of_e1`` is the ISSUE-18 acceptance comparator:
+    the steady non-flush sharded round vs the SAME-mesh E=1 round.
+
+    With <2 devices the whole config runs on a virtual 8-device CPU
+    mesh in a subprocess, labeled ``backend: cpu-mesh-sim`` — host
+    simulation, not ICI, so cross-shard wall-clock ratios would
+    measure vCPU timeslicing and every reported ratio stays WITHIN one
+    mesh width. The on-chip number lands via tools/tpu_capture.py
+    ``sharded_perf``."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        if os.environ.get("GRAPEVINE_SHARDED_SUBPROC"):
+            return {"skipped": f"cpu-mesh child saw {n_dev} device(s)"}
+        return _sharded_subprocess(smoke, "sharded_evict_ab")
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from grapevine_tpu.analysis.costmodel import machinery_oram_cfg
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.oram.round import oram_flush, oram_round
+    from grapevine_tpu.parallel.mesh import (
+        _SHARD_MAP_NOCHECK,
+        TREE_AXIS,
+        _oram_specs,
+        _shard_map,
+        make_mesh,
+    )
+
+    reps = 3 if smoke else 7
+    cap_n, b = (4096, 64) if smoke else (65536, 256)
+    es = (1, 2, 4)
+    shard_arms = [s for s in (1, 2, 4) if s <= n_dev]
+    rng = np.random.default_rng(18)
+    height = max(1, cap_n.bit_length() - 2)
+    idxs = jnp.asarray(rng.integers(0, cap_n + 1, b).astype(np.uint32))
+    nl = jnp.asarray(rng.integers(0, 1 << height, b).astype(np.uint32))
+    dl = jnp.asarray(rng.integers(0, 1 << height, b).astype(np.uint32))
+    specs = _oram_specs()
+    out = {
+        "machinery": {},
+        # geometry keys (tools/check_perf_regression.py): a re-swept
+        # arm grid is a different line, never a regression comparison
+        "shard_count": ",".join(str(s) for s in shard_arms),
+        "evict_every": ",".join(str(e) for e in es),
+    }
+    for s in shard_arms:
+        mesh = make_mesh(jax.devices()[:s])
+        grid = {}
+        for e in es:
+            cfg = machinery_oram_cfg(cap_n, b, e=e)
+            assert cfg.n_buckets_padded % s == 0
+            state = jax.tree.map(
+                lambda sp, x: jax.device_put(x, NamedSharding(mesh, sp)),
+                specs, init_oram(cfg, jax.random.PRNGKey(1)),
+                is_leaf=lambda sp: isinstance(sp, P),
+            )
+
+            def apply_batch(vals0, present0):
+                return jnp.sum(vals0, axis=1), vals0, present0
+
+            def one_round(st, cfg=cfg):
+                return oram_round(cfg, st, idxs, nl, dl, apply_batch,
+                                  axis_name=TREE_AXIS)
+
+            jit_round = jax.jit(_shard_map(
+                one_round, mesh=mesh, in_specs=(specs,),
+                out_specs=(specs, P(), P()), **_SHARD_MAP_NOCHECK,
+            ))
+            t_round = _min_of(jit_round, (state,), reps)
+            entry = {}
+            if e > 1:
+                entry["fetch_round_ms"] = round(t_round * 1e3, 3)
+                # flush timed at a 1-round fill: every flush shape is a
+                # static function of the geometry (obliviousness means
+                # fill level cannot change the cost)
+                st1, _, _ = jit_round(state)
+                jit_flush = jax.jit(_shard_map(
+                    lambda st, cfg=cfg: oram_flush(cfg, st, TREE_AXIS),
+                    mesh=mesh, in_specs=(specs,), out_specs=specs,
+                    **_SHARD_MAP_NOCHECK,
+                ))
+                t_flush = _min_of(jit_flush, (st1,), reps)
+                entry["flush_ms"] = round(t_flush * 1e3, 3)
+                entry["amortized_round_ms"] = round(
+                    (t_round + t_flush / e) * 1e3, 3
+                )
+            else:
+                entry["amortized_round_ms"] = round(t_round * 1e3, 3)
+            grid[f"e{e}"] = entry
+        base = grid["e1"]["amortized_round_ms"]
+        for e in es[1:]:
+            g = grid[f"e{e}"]
+            g["speedup_over_e1"] = round(
+                base / g["amortized_round_ms"], 3
+            )
+            g["fetch_fraction_of_e1"] = round(
+                g["fetch_round_ms"] / base, 3
+            )
+        grid["model"] = _model_ab(
+            "sharded_evict",
+            min((f"e{e}" for e in es),
+                key=lambda a: grid[a]["amortized_round_ms"]),
+            scope="machinery", cap_n=cap_n, batch=b, arms=list(es),
+            shards=s,
+        )
+        out["machinery"][f"round_cap{cap_n}_b{b}_s{s}"] = grid
+    return out
+
+
 def _xla_flags_supported(flags: str) -> bool:
     """True iff this jaxlib parses ``flags`` (older ones abort on
     unknown XLA flags). Mirrors tests/conftest.py, incl. the per-jaxlib
@@ -1389,8 +1514,9 @@ def _xla_flags_supported(flags: str) -> bool:
     return ok
 
 
-def _sharded_subprocess(smoke):
-    """Run this file's sharded config on a virtual CPU mesh, isolated."""
+def _sharded_subprocess(smoke, config="sharded"):
+    """Run one of this file's sharded configs on a virtual CPU mesh,
+    isolated in a subprocess (the backend cannot switch after init)."""
     import json as _json
     import os
     import subprocess
@@ -1427,7 +1553,7 @@ def _sharded_subprocess(smoke):
     code = (
         "import jax; jax.config.update('jax_platforms','cpu')\n"
         "import json, bench\n"
-        "print('SHARDED_JSON ' + json.dumps(bench.bench_sharded(True)))\n"
+        f"print('SHARDED_JSON ' + json.dumps(bench.bench_{config}(True)))\n"
     )
     # under --smoke a broken sharded path must FAIL the harness gate
     # (error), not silently pass as skipped
@@ -2154,6 +2280,7 @@ CONFIGS = [
     ("evict_ab", bench_evict_ab),
     ("expiry_sweep", bench_expiry_sweep),
     ("sharded", bench_sharded),
+    ("sharded_evict_ab", bench_sharded_evict_ab),
     ("server_loopback", bench_server_loopback),
     ("slo_loopback", bench_slo_loopback),
     ("pipeline_ab", bench_pipeline_ab),

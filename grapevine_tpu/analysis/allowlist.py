@@ -283,4 +283,20 @@ RANGE_ALLOWLIST: tuple = (
        "stable partition is a permutation of [0, B) (sums below B "
        "pointwise, 2B only in interval arithmetic); the adjacent clip "
        "re-bounds the lane for downstream"),
+    # owner-masked sharded write-back (parallel/mesh.py composition;
+    # ISSUE 18): each chip rebases global heap rows into its local
+    # shard range before the drop-mode scatter
+    _A("sub", "oram/path_oram.py:_path_scatter",
+       "path_b - axis_index*n_local rebase: non-owned lanes wrap mod "
+       "2^32 by construction and the owner mask routes exactly those "
+       "lanes to the out-of-range drop sentinel — a wrapped value is "
+       "never a landing address (sharded==single-chip bit-equality, "
+       "tests/test_parallel.py)"),
+    _A("convert_element_type", "oram/path_oram.py:_path_scatter",
+       "drop-mode scatter target cast u32->int32: owned lanes are "
+       "< n_local (fits, at every certified geometry) by the owner "
+       "mask the interval domain cannot relate; non-owned lanes carry "
+       "the wrapped rebase and drop out of bounds — write-drop is the "
+       "documented masking idiom, so the cast only ever narrows the "
+       "drop sentinel"),
 )

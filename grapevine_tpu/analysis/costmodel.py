@@ -241,6 +241,49 @@ def oram_flush_rows(cfg, prefix: str = "") -> dict:
     return rows
 
 
+def _sharded_plane(name: str) -> bool:
+    """True for planes the mesh shards along the bucket axis: the outer
+    tree/nonce planes of either engine tree. Inner posmap trees
+    (``pm_``) and the tree-top cache planes replicate on every chip
+    (parallel/mesh._oram_specs — the ROADMAP item 1/3 composition point
+    keeps the internal map whole), so their scatters land in full per
+    chip while the outer trees' owner-masked scatters partition."""
+    if "pm_" in name:
+        return False
+    base = (name.split("_", 1)[1]
+            if name.startswith(("rec_", "mb_")) else name)
+    return base.startswith(("tree_", "nonces"))
+
+
+def shard_local_rows(rows: dict, shards: int) -> dict:
+    """The shard-LOCAL view of an analytic rows dict (ISSUE 18): every
+    sharded plane's leading dim divides by the shard count (one
+    contiguous heap range per chip), while replicated planes — cache,
+    inner posmap trees — keep their full shape. Row COUNTS are
+    untouched: each chip's fetch gathers the full uniform
+    ``B·(path_len−k)`` masked rows from its local range, and each
+    chip's flush dispatches the full uniform ``t``-row drop-mode
+    scatter — the owner mask bounds which rows LAND, never the static
+    per-chip op shape (the leak argument in oram/round.py)."""
+    if shards < 1 or shards & (shards - 1):
+        raise ValueError(f"shards={shards}: want a power of two >= 1")
+    out = {}
+    for name, pr in rows.items():
+        if pr.hbm and _sharded_plane(name):
+            n = pr.shape[0]
+            if n % shards:
+                raise ValueError(
+                    f"{name}: {n} rows do not divide over {shards} "
+                    "shards — the bucket axis shards as contiguous "
+                    "equal heap ranges"
+                )
+            pr = dataclasses.replace(
+                pr, shape=(n // shards,) + tuple(pr.shape[1:])
+            )
+        out[name] = pr
+    return out
+
+
 def engine_planes(ecfg) -> dict:
     """Both trees' plane declarations for one engine round/flush."""
     return {**oram_planes(ecfg.rec, "rec_"),
@@ -423,6 +466,42 @@ def trace_oram_flush(cfg):
     return jax.make_jaxpr(lambda st: oram_flush(cfg, st))(state)
 
 
+def trace_sharded_oram_flush(cfg, shards: int):
+    """Jaxpr of one owner-masked sharded ``oram_flush`` under
+    ``shard_map`` on a ``shards``-device mesh slice — the engine's
+    exact sharding geometry (parallel/mesh.py), so ``walk_eqns``
+    recurses into the shard body where every sharded plane operand
+    carries its SHARD-LOCAL shape (the
+    tools/check_tree_cache_oblivious.py sharded-audit recipe)."""
+    import jax
+
+    from ..oram.path_oram import init_oram
+    from ..oram.round import oram_flush
+    from ..parallel.mesh import (
+        _SHARD_MAP_NOCHECK,
+        TREE_AXIS,
+        _oram_specs,
+        _shard_map,
+        make_mesh,
+    )
+
+    devs = jax.devices()
+    if len(devs) < shards:
+        raise ValueError(
+            f"shards={shards} but only {len(devs)} JAX device(s) are "
+            "visible — the sharded flush trace needs a real mesh slice"
+        )
+    mesh = make_mesh(devs[:shards])
+    specs = _oram_specs()
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    fn = _shard_map(
+        lambda st: oram_flush(cfg, st, TREE_AXIS),
+        mesh=mesh, in_specs=(specs,), out_specs=specs,
+        **_SHARD_MAP_NOCHECK,
+    )
+    return jax.make_jaxpr(fn)(state)
+
+
 def _engine_batch_spec(ecfg):
     import jax
     import numpy as np
@@ -554,6 +633,43 @@ def cross_validate_flush(cfg, *, _corrupt=None) -> dict:
     )
 
 
+def cross_validate_sharded_flush(cfg, shards: int, *,
+                                 _corrupt=None) -> dict:
+    """One owner-masked SHARDED ``oram_flush`` (ISSUE 18): the analytic
+    shard-local rows — full-shape ``t``-row scatters against
+    shard-local plane shapes, replicated inner-posmap planes untouched
+    — must agree bit-exactly with the shard_map-traced census. A model
+    that prices each chip's scatter at its owned share (``t/shards``)
+    fails here as a scatter-undercount: the owner mask bounds which
+    rows land, not the uniform static per-chip op shape."""
+    t = flush_target_rows(cfg)
+    n_local = cfg.n_buckets_padded // shards
+    # audit-geometry ambiguity guard (the tree-cache census's caveat):
+    # the flush compacts private buffers into exactly t-row arrays, so
+    # t (and the buffer slot count) must not collide with any local
+    # plane's leading dim or shape-class attribution goes ambiguous
+    if t == n_local or cfg.evict_buffer_slots == n_local:
+        raise ValueError(
+            f"sharded-flush audit geometry ambiguity: t={t}, "
+            f"buffer={cfg.evict_buffer_slots} vs n_local={n_local} — "
+            "pick a window/fetch count whose dedup bound differs from "
+            "the shard-local bucket count"
+        )
+    pred = shard_local_rows(oram_flush_rows(cfg), shards)
+    if _corrupt is not None:
+        pred = _corrupt(pred)
+    planes = {name: (pr.shape, pr.divisor)
+              for name, pr in shard_local_rows(
+                  oram_flush_rows(cfg), shards).items()}
+    return _compare(
+        predicted_access_rows(pred),
+        traced_access_rows(trace_sharded_oram_flush(cfg, shards), planes),
+        f"sharded_oram_flush(shards={shards}, E={cfg.evict_window}, "
+        f"F={cfg.evict_fetch_count}, t={t}, n_local={n_local}, "
+        f"recursive={cfg.posmap is not None})",
+    )
+
+
 def cross_validate_engine_round(ecfg, *, _corrupt=None) -> dict:
     """One full engine round (rounds A+B+C): the composed analytic model
     — mailbox twice at ``B·D``, records once at ``B`` — against the
@@ -621,16 +737,33 @@ class PhaseCost:
     cipher_rows: int = 0  # rows through the bucket-cipher keystream
     sort_keys: int = 0  # keys entering sort/rank machinery
     scatter_elems: int = 0  # scattered u32 elements
+    #: the subset of scatter_bytes landing in mesh-SHARDED planes
+    #: (outer tree/nonce planes): under a sharded engine these
+    #: partition by the owner mask, while the remainder (replicated
+    #: inner-posmap trees) lands in full on every chip
+    sharded_scatter_bytes: int = 0
 
     @property
     def hbm_bytes(self) -> int:
         return self.gather_bytes + self.scatter_bytes
 
+    def per_chip_bytes(self, shards: int) -> float:
+        """HBM bytes ONE chip of a ``shards``-way mesh moves for this
+        phase: gathers keep their full uniform per-chip count (each
+        chip reads the whole masked working set from its local range —
+        the leak argument), owner-masked scatters partition (modeled
+        uniform; the aggregate across chips is exactly the single-chip
+        write set — shard counts are powers of two, so the binary
+        division is exact), replicated-plane scatters land in full."""
+        repl = self.scatter_bytes - self.sharded_scatter_bytes
+        return (self.gather_bytes + repl
+                + self.sharded_scatter_bytes / shards)
+
     def add_rows(self, rows: dict) -> "PhaseCost":
         """Accumulate the HBM-resident planes (private ``cache_*``
         planes carry no HBM traffic — they exist for the bit-exact
         row cross-validation, not the byte ledger)."""
-        for pr in rows.values():
+        for name, pr in rows.items():
             if not pr.hbm:
                 continue
             self.gather_rows += pr.gather_rows
@@ -639,6 +772,10 @@ class PhaseCost:
             self.scatter_bytes += (
                 pr.scatter_rows * pr.row_words * WORD_BYTES
             )
+            if _sharded_plane(name):
+                self.sharded_scatter_bytes += (
+                    pr.scatter_rows * pr.row_words * WORD_BYTES
+                )
             self.scatter_elems += pr.scatter_rows * pr.row_words
         return self
 
@@ -650,6 +787,9 @@ class CostLedger:
 
     phases: dict  # phase name -> PhaseCost
     evict_every: int
+    #: bucket-tree shard count the per-chip views divide over (ISSUE
+    #: 18); 1 = single chip. Power of two, like the mesh it models.
+    shards: int = 1
 
     @property
     def steady_round_bytes(self) -> float:
@@ -678,10 +818,28 @@ class CostLedger:
             1, self.evict_every
         )
 
+    @property
+    def per_shard_steady_round_bytes(self) -> float:
+        """HBM bytes ONE chip of the ``shards``-way mesh moves per
+        steady-state round (ISSUE 18): gathers keep the full uniform
+        per-chip row count (each chip reads the whole masked path
+        working set from its local heap range — the leak argument),
+        owner-masked scatters into the sharded outer trees partition
+        (sum across chips = exactly the single-chip write set; the
+        power-of-two division is exact in binary), and replicated-
+        plane scatters (inner posmap trees) land in full per chip.
+        ``shards=1`` reduces to :attr:`steady_round_bytes` exactly."""
+        total = (self.phases["fetch"].per_chip_bytes(self.shards)
+                 + self.phases["writeback"].per_chip_bytes(self.shards))
+        return total + self.phases["flush"].per_chip_bytes(
+            self.shards
+        ) / max(1, self.evict_every)
+
     def floor_ms(self, gbytes_per_s: float) -> float:
         """Roofline round-time floor at a calibrated achieved
-        bandwidth: modeled steady-state bytes / bandwidth."""
-        return self.steady_round_bytes / (gbytes_per_s * 1e6)
+        bandwidth: modeled per-chip steady-state bytes / bandwidth
+        (per-chip == total on a single chip)."""
+        return self.per_shard_steady_round_bytes / (gbytes_per_s * 1e6)
 
 
 def _round_sort_keys(cfg, b: int, sort_impl: str, occ_impl: str) -> int:
@@ -751,9 +909,15 @@ def _flush_cipher_rows(cfg) -> int:
     return inner
 
 
-def engine_cost_ledger(ecfg, occ_impl: str | None = None) -> CostLedger:
+def engine_cost_ledger(ecfg, occ_impl: str | None = None,
+                       shards: int = 1) -> CostLedger:
     """The full modeled ledger for one engine geometry × knob setting —
-    the object obs/costmon.py exports and bench.py grades."""
+    the object obs/costmon.py exports and bench.py grades. ``shards``
+    is the bucket-tree mesh width (GrapevineConfig.shards — engine
+    geometry that deliberately lives OUTSIDE EngineConfig, so it is a
+    parameter here, not a field read off ``ecfg``)."""
+    if shards < 1 or shards & (shards - 1):
+        raise ValueError(f"shards={shards}: want a power of two >= 1")
     occ = occ_impl if occ_impl is not None else (
         "scan" if ecfg.vphases_impl == "scan" else "dense"
     )
@@ -807,6 +971,7 @@ def engine_cost_ledger(ecfg, occ_impl: str | None = None) -> CostLedger:
         phases={"fetch": fetch, "writeback": wb, "flush": flush,
                 "sweep": sweep},
         evict_every=ecfg.evict_every,
+        shards=shards,
     )
 
 
@@ -858,6 +1023,25 @@ def oram_steady_bytes(cfg, b: int) -> float:
     return float(total)
 
 
+def oram_sharded_steady_bytes(cfg, b: int, shards: int) -> float:
+    """Per-CHIP amortized HBM bytes per round of one isolated ORAM on a
+    ``shards``-way mesh (ISSUE 18): gather bytes stay at the full
+    uniform per-chip count, owner-masked scatter bytes into the sharded
+    tree planes divide (the uniform-partition idealization — the true
+    per-chip split is path-dependent over the contiguous heap ranges,
+    but the aggregate is exactly the single-chip write set), and
+    replicated inner-posmap scatters land in full. ``shards=1`` equals
+    :func:`oram_steady_bytes` exactly."""
+    if shards < 1 or shards & (shards - 1):
+        raise ValueError(f"shards={shards}: want a power of two >= 1")
+    pc = PhaseCost().add_rows(oram_round_rows(cfg, b))
+    total = pc.per_chip_bytes(shards)
+    if cfg.delayed_eviction:
+        fl = PhaseCost().add_rows(oram_flush_rows(cfg))
+        total += fl.per_chip_bytes(shards) / cfg.evict_window
+    return float(total)
+
+
 #: arms whose modeled bytes sit within this fraction of the best arm
 #: are a byte-tie: the verdict then prefers the structurally smaller
 #: arm (less machinery — no dedup sort, no buffer, no private cache)
@@ -876,7 +1060,7 @@ def _pick(arms: dict, order) -> str:
 
 def ab_verdict(kind: str, *, scope: str = "machinery",
                cap_n: int = 65536, batch: int = 256, arms=None,
-               backend: str = "cpu") -> dict:
+               backend: str = "cpu", shards: int = 1) -> dict:
     """The model's pick for one shipped A/B config — the number
     bench.py reports next to the measured winner and
     tools/check_cost_model.py grades against every banked
@@ -928,6 +1112,24 @@ def ab_verdict(kind: str, *, scope: str = "machinery",
             "(a byte-tie, so the window's dedup sort + buffer are pure "
             "overhead and E=1 wins); past saturation the min clamps "
             "and larger E strictly drops bytes"
+        )
+    elif kind == "sharded_evict":
+        es = tuple(arms) if arms else (1, 2, 4)
+        out["shards"] = shards
+        for e in es:
+            cfg = machinery_oram_cfg(cap_n, batch, e=e)
+            nbytes = oram_sharded_steady_bytes(cfg, batch, shards)
+            out["arms"][f"e{e}"] = {"modeled_bytes": int(nbytes)}
+        out["winner"] = _pick(out["arms"], [f"e{e}" for e in es])
+        out["basis"] = (
+            "per-chip bytes on the mesh: gathers replicate at the full "
+            "uniform count (the leak argument), owner-masked scatters "
+            "partition /shards with the union exactly the single-chip "
+            "write set — the shard count scales only the scatter half, "
+            "so the E verdict keeps the single-chip structure (byte-"
+            "tie below window saturation, least machinery wins; past "
+            "saturation the dedup min clamps and larger E strictly "
+            "drops per-chip bytes)"
         )
     elif kind == "sort":
         out["arms"] = {"xla": {"model": "W·log2(W) compare sort"},
@@ -1022,6 +1224,17 @@ def _halve_flush(rows):
     return _scale_plane(rows, "tree_val", s=0.5)
 
 
+@_cost_mutant("halve_sharded_flush_scatter", "flush_sharded",
+              "scatter-undercount")
+def _halve_sharded_flush(rows):
+    """A model that prices each chip's flush scatter at its OWNED row
+    share (t/shards) — the ISSUE-18 slip: the owner mask bounds which
+    rows LAND in HBM (the byte ledger's division), never the uniform
+    ``t``-row drop-mode scatter shape every chip dispatches (what the
+    traced census counts — the leak argument)."""
+    return _scale_plane(rows, "tree_val", s=0.5)
+
+
 @_cost_mutant("forget_inner_posmap_round", "round_recursive",
               "gather-undercount")
 def _forget_inner(rows):
@@ -1107,6 +1320,30 @@ def audit_oram_configs():
     ]
 
 
+def audit_sharded_flush_configs():
+    """The sharded-flush audit geometries (ISSUE 18): the owner-masked
+    flush cross-validated on the widest mesh slice actually visible
+    (2-way when >=2 devices, else a degenerate 1-way mesh — still a
+    real shard_map trace, so the recipe never silently skips). Flat and
+    recursive (replicated inner trees flushing inside the same pass);
+    ``F=6`` keeps the dedup bound ``t = 2*6*8 = 96`` distinct from the
+    2-way local bucket count 128 (the ambiguity guard)."""
+    import jax
+
+    from ..oram.path_oram import OramConfig
+    from ..oram.posmap import derive_posmap_spec
+
+    shards = 2 if len(jax.devices()) >= 2 else 1
+    geo = dict(height=7, value_words=8, n_blocks=128, cipher_rounds=8,
+               top_cache_levels=2, evict_window=2, evict_fetch_count=6,
+               evict_buffer_slots=64)
+    flat = OramConfig(**geo)
+    rec = OramConfig(**geo, posmap=derive_posmap_spec(
+        128, top_cache_levels=2, evict_window=2, evict_fetch_count=6))
+    return [("sharded_flush_flat", flat, shards),
+            ("sharded_flush_recursive", rec, shards)]
+
+
 def audit_engine_configs():
     """The engine-level audit geometries: E=1 (joint fetch+write-back
     round) and E=2 (fetch-only rounds + the flush), both sized so both
@@ -1133,7 +1370,10 @@ def _mutant_fixtures():
     cached, cached_b = by_name["flat_k2_e1"]
     recursive, rec_b = by_name["recursive_k2_e1"]
     evict, _ = by_name["flat_k2_e2_fetch"]
+    _, sh_cfg, sh_n = audit_sharded_flush_configs()[0]
     return {
+        "flush_sharded": (cross_validate_sharded_flush,
+                          {"cfg": sh_cfg, "shards": sh_n}),
         "round": (cross_validate_round, {"cfg": flat, "b": flat_b}),
         "round_cached": (cross_validate_round,
                          {"cfg": cached, "b": cached_b}),

@@ -163,6 +163,7 @@ class _Ctx:
         self.env: dict = {}  # var -> Iv
         self.alias: dict = {}  # sub-jaxpr invar -> caller atom
         self.preds: dict = {}  # pred var -> (rel, a_atom, b_atom)
+        self.axis_sizes: dict = {}  # mesh axis name -> size (shard_map)
 
     def flag(self, kind: str, eqn, message: str):
         f = RangeFinding(
@@ -642,10 +643,31 @@ def _propagate(closed, in_ivs, ctx: _Ctx, in_atoms=None) -> list:
         elif name == "scan":
             outs = _scan_transfer(eqn, ins, ctx)
 
+        # ---- mesh collectives -----------------------------------------
+        elif name == "axis_index":
+            # a chip's coordinate along a shard_map mesh axis: exactly
+            # [0, axis_size - 1]. Without this the owner-base arithmetic
+            # in parallel/mesh.py (_path_gather/_path_scatter:
+            # axis_index * n_local) degrades to full-u32 and every
+            # downstream add/mul reads as a wrap.
+            ax = ctx.axis_sizes.get(str(eqn.params.get("axis_name")))
+            outs = [(0, ax - 1)] if ax else [out_rngs[0]]
+
         # ---- nesting / default ----------------------------------------
         else:
             subs = list(_sub_jaxprs(eqn))
             if subs:
+                mesh = eqn.params.get("mesh")
+                if mesh is not None and hasattr(mesh, "shape"):
+                    # shard_map boundary: record axis sizes so inner
+                    # axis_index eqns get their exact interval
+                    try:
+                        ctx.axis_sizes.update(
+                            {str(k): int(v)
+                             for k, v in dict(mesh.shape).items()}
+                        )
+                    except (TypeError, ValueError):  # pragma: no cover
+                        pass
                 outs = None
                 for sub in subs:
                     n_in = len(getattr(sub, "jaxpr", sub).invars)

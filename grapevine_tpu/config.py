@@ -187,6 +187,21 @@ class GrapevineConfig:
                 "rides the phase-major batched round, and the op-major "
                 "engine stays cache-free as the differential oracle"
             )
+        sh = self.shards
+        if not isinstance(sh, int) or sh < 1 or sh & (sh - 1):
+            raise ValueError(
+                f"shards must be a power-of-two int >= 1, got {sh!r} — "
+                "the bucket trees shard as contiguous equal heap ranges "
+                "(parallel/mesh.py)"
+            )
+        if self.commit == "op" and sh != 1:
+            raise ValueError(
+                "commit='op' (the differential-oracle engine) supports "
+                "only shards=1 — the sharded step/flush programs wrap "
+                "the phase-major batched round (parallel/mesh.py "
+                "make_sharded_step), and the op-major engine stays "
+                "single-chip as the differential oracle"
+            )
     #: slot-order semantics implementation for the phase-major engine's
     #: vectorized phases (engine/vphases.py): "dense" = [B,B] masked
     #: matrices + one-hot bool-matmuls (MXU-shaped; O(B²) compute and
@@ -344,6 +359,22 @@ class GrapevineConfig:
     #: OPERATIONS.md §19; overflow increments the same sticky counter
     #: the stash uses and trips the health fold.
     evict_buffer_slots: int | None = None
+
+    #: bucket-tree shard count across the device mesh (parallel/mesh.py):
+    #: 1 = single-chip (the default; no mesh machinery compiled), N > 1
+    #: = both payload trees (+ nonce planes) shard as contiguous heap
+    #: ranges over the first N devices, everything else replicated; the
+    #: engine's round AND flush dispatch through make_sharded_step /
+    #: make_sharded_flush (evict_every composes — the owner-masked
+    #: flush). Deliberately NOT part of EngineConfig and therefore NOT
+    #: covered by the checkpoint/journal fingerprint: responses, final
+    #: state, and the journal stream are bit-identical at every shard
+    #: count (tests/test_parallel.py), so a journal written on one chip
+    #: replays bit-identically on a mesh and vice versa — the same
+    #: standing as pipeline_depth. Requires commit="phase", a
+    #: power-of-two count that divides both trees' padded bucket counts,
+    #: and at least that many JAX devices at engine construction.
+    shards: int = 1
 
     #: hash choices per recipient in the mailbox table. 2 (default for
     #: the phase-major engine) = power-of-two-choices: a new recipient

@@ -266,11 +266,42 @@ class GrapevineEngine:
         self.config = config or GrapevineConfig()
         self.ecfg = EngineConfig.from_config(self.config)
         self.state: EngineState = init_engine(self.ecfg, seed)
-        step_fn = engine_round_step if self.config.commit == "phase" else engine_step
-        # donate the state: trees update in place (no per-round copy,
-        # and the fused pallas scatter's input/output aliasing would
-        # otherwise force XLA to defensively copy both tree arrays)
-        self._step = jax.jit(step_fn, static_argnums=(0,), donate_argnums=(1,))
+        #: bucket-axis sharding (config.py ``shards``; parallel/mesh.py):
+        #: at shards > 1 the step/flush dispatch through the shard_map'd
+        #: programs on a mesh over the first N devices. The adapters
+        #: below keep the single-chip call signatures (ecfg, state, ...)
+        #: so every dispatch/replay/flush site stays shard-agnostic —
+        #: bit-identical results are the mesh contract, so nothing
+        #: downstream (journal, checkpoint, leakmon, oracle suites) can
+        #: tell the difference.
+        self._mesh = None
+        if self.config.shards > 1:
+            from ..parallel import (
+                make_mesh, make_sharded_step, shard_engine_state,
+            )
+
+            devs = jax.devices()
+            if len(devs) < self.config.shards:
+                raise ValueError(
+                    f"shards={self.config.shards} but only {len(devs)} "
+                    "JAX device(s) are visible — the bucket trees shard "
+                    "one contiguous heap range per device"
+                )
+            self._mesh = make_mesh(devs[: self.config.shards])
+            self._shard_state = shard_engine_state
+            self.state = shard_engine_state(self.state, self._mesh)
+            sstep = make_sharded_step(self.ecfg, self._mesh)
+            step_fn = lambda _ecfg, state, batch: sstep(state, batch)  # noqa: E731
+            self._step = step_fn
+        else:
+            step_fn = (engine_round_step if self.config.commit == "phase"
+                       else engine_step)
+            # donate the state: trees update in place (no per-round copy,
+            # and the fused pallas scatter's input/output aliasing would
+            # otherwise force XLA to defensively copy both tree arrays)
+            self._step = jax.jit(
+                step_fn, static_argnums=(0,), donate_argnums=(1,)
+            )
         self._sweep = jax.jit(
             expiry_sweep, static_argnums=(0,), donate_argnums=(1,)
         )
@@ -284,12 +315,17 @@ class GrapevineEngine:
         #: recovered from state (rec.ebuf_rounds) so a crash can never
         #: desynchronize cadence from content.
         self.evict_every = self.ecfg.evict_every
-        self._flush_step = (
-            jax.jit(engine_flush_step, static_argnums=(0,),
-                    donate_argnums=(1,))
-            if self.evict_every > 1
-            else None
-        )
+        if self.evict_every <= 1:
+            self._flush_step = None
+        elif self._mesh is not None:
+            from ..parallel import make_sharded_flush
+
+            sflush = make_sharded_flush(self.ecfg, self._mesh)
+            self._flush_step = lambda _ecfg, state: sflush(state)
+        else:
+            self._flush_step = jax.jit(
+                engine_flush_step, static_argnums=(0,), donate_argnums=(1,)
+            )
         self._rounds_since_flush = 0
         #: replay-time cadence audit (see _replay_record): rounds seen
         #: since the last KIND_FLUSH record; None until the first
@@ -353,6 +389,13 @@ class GrapevineEngine:
                 self.state = self.durability.recover(
                     self.state, self._replay_record
                 )
+                if self._mesh is not None:
+                    # a loaded checkpoint materializes host-side on the
+                    # default device; re-place it on the mesh so the
+                    # first live round doesn't pay an implicit reshard
+                    # (replayed rounds already ran the sharded program,
+                    # so this is a no-op re-placement in that case)
+                    self.state = self._shard_state(self.state, self._mesh)
                 jax.block_until_ready(self.state.free_top)
         if self.evict_every > 1:
             # cadence counter recovered FROM STATE, never from a host

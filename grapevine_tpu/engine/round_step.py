@@ -447,7 +447,11 @@ FLUSH_OBLINT_SECRETS = (
 )
 
 
-def engine_flush_step(ecfg: EngineConfig, state: EngineState) -> EngineState:
+def engine_flush_step(
+    ecfg: EngineConfig,
+    state: EngineState,
+    axis_name: str | None = None,
+) -> EngineState:
     """One delayed-eviction flush over both trees (PR 15; ROADMAP item 1).
 
     Called by the engine every ``evict_every`` rounds on the
@@ -459,10 +463,19 @@ def engine_flush_step(ecfg: EngineConfig, state: EngineState) -> EngineState:
     recurses). A no-op-shaped pass at ``evict_every == 1`` is never
     dispatched — the engine only compiles this program when delayed
     eviction is on.
+
+    With ``axis_name`` set the call runs inside ``shard_map``
+    (parallel/mesh.py:make_sharded_flush): both trees' write-back
+    scatters are owner-masked to each chip's heap range and everything
+    else — eviction buffer, stash, dedup, the recursive inner trees —
+    stays the replicated axis-free program (the oram_flush docstring
+    carries the leak argument).
     """
     from ..oram.round import oram_flush
 
     with device_phase("engine_flush"):
-        rec = oram_flush(ecfg.rec, state.rec, sort_impl=ecfg.sort_impl)
-        mb = oram_flush(ecfg.mb, state.mb, sort_impl=ecfg.sort_impl)
+        rec = oram_flush(ecfg.rec, state.rec, axis_name,
+                         sort_impl=ecfg.sort_impl)
+        mb = oram_flush(ecfg.mb, state.mb, axis_name,
+                        sort_impl=ecfg.sort_impl)
     return state._replace(rec=rec, mb=mb)

@@ -981,6 +981,19 @@ def oram_flush(
     bump. Deterministic given the state (no RNG), so journal replay
     re-executes it bit-identically (engine/journal.py KIND_FLUSH).
     Recursive position maps flush their internal tree in the same call.
+
+    **Sharded (``axis_name`` set, inside shard_map).** The dedup, the
+    eviction assignment, and the stash/buffer recompaction all run on
+    the replicated private working set — identical on every chip, no
+    collective — and only the final tree/nonce scatters change: the
+    ``_path_scatter`` sharded branch ANDs the ``tree_tgt`` owner mask
+    with each chip's contiguous heap range, so every chip writes
+    exactly the target rows it owns and the union across the mesh is
+    the single-chip flush bit for bit. The per-chip scatter still
+    carries all ``t`` compacted rows (uniform static shape — row
+    counts stay a pure function of geometry, never contents); non-owned
+    rows drop out of bounds. Cache planes and the recursive inner tree
+    are replicated private state and always take the axis-free path.
     """
     from .posmap import inner_oram_config
 
@@ -995,8 +1008,15 @@ def oram_flush(
     posmap = state.posmap
     if recursive:
         icfg = inner_oram_config(cfg.posmap)
+        # the INNER tree is replicated private state (mesh.py P() specs),
+        # never sharded — its flush must run the axis-free program on
+        # every chip (the same convention oram_round uses for inner
+        # accesses). Passing the outer axis_name here would owner-mask a
+        # replicated plane against its FULL size: shard 0 would own
+        # everything and every other replica nothing, silently diverging
+        # the replicas on the first recursive flush.
         posmap = posmap._replace(
-            inner=oram_flush(icfg, posmap.inner, axis_name, sort_impl)
+            inner=oram_flush(icfg, posmap.inner, None, sort_impl)
         )
 
     with device_phase("oram_flush"):

@@ -12,8 +12,10 @@ from .mesh import (
     engine_state_specs,
     init_sharded_engine,
     make_mesh,
+    make_sharded_flush,
     make_sharded_step,
     shard_engine_state,
+    validate_sharded_geometry,
 )
 
 __all__ = [
@@ -21,6 +23,8 @@ __all__ = [
     "engine_state_specs",
     "init_sharded_engine",
     "make_mesh",
+    "make_sharded_flush",
     "make_sharded_step",
     "shard_engine_state",
+    "validate_sharded_geometry",
 ]

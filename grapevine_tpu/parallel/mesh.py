@@ -33,7 +33,7 @@ import functools
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.round_step import engine_round_step
+from ..engine.round_step import engine_flush_step, engine_round_step
 from ..engine.state import EngineConfig, EngineState
 from ..oram.path_oram import OramState
 
@@ -77,14 +77,19 @@ def _oram_specs() -> OramState:
         stash_idx=P(),
         stash_val=P(),
         stash_leaf=P(),
-        # delayed-eviction buffer + window bookkeeping (PR 15): would be
-        # replicated private state with the stash's standing, but the
-        # sharded path currently supports evict_every=1 ONLY — there is
-        # no sharded flush program yet (engine_flush_step/oram_flush
-        # take no axis_name; composing the deduplicated flush targets
-        # with bucket-axis sharding is the ROADMAP item-1∘2 follow-up),
-        # so make_sharded_step rejects delayed-eviction geometries and
-        # these specs only ever carry the zero-length E=1 planes
+        # delayed-eviction buffer + window bookkeeping (PR 15):
+        # REPLICATED private state, the stash's standing — decided, not
+        # defaulted. Every chip's fetch round psums the identical full
+        # working set (_path_gather), then runs the identical branchless
+        # accumulation into these planes, so the replicas stay
+        # bit-identical with zero extra collectives; sharding them would
+        # buy back KBs of HBM (the buffer is E·F·≈4 entries, not the
+        # GB-scale trees) at the price of a collective in the flush's
+        # eviction assignment. The flush (make_sharded_flush →
+        # engine_flush_step(axis_name=...) → oram_flush) reads the
+        # replicated buffer ∪ stash everywhere and owner-masks only the
+        # final tree/nonce scatters per chip, so the union across the
+        # mesh is the single-chip flush bit for bit.
         ebuf_idx=P(),
         ebuf_val=P(),
         ebuf_leaf=P(),
@@ -151,6 +156,28 @@ def init_sharded_engine(ecfg: EngineConfig, mesh: Mesh, seed: int = 0) -> Engine
     )()
 
 
+def validate_sharded_geometry(ecfg: EngineConfig, mesh: Mesh) -> None:
+    """Directed refusal for knob combinations the sharded programs do
+    not cover: raise a precise error naming the combination, or return.
+
+    Everything the sharded step/flush pair DOES cover is silent here:
+    evict_every >= 1 (the owner-masked flush), recursive position maps
+    (inner trees replicated), tree-top caching (cache planes
+    replicated), all cipher impls (the fused Pallas scatter falls back
+    to the jnp cipher inside shard_map), both sort/vphases impls.
+    """
+    n_dev = mesh.devices.size
+    for label, cfg in (("records", ecfg.rec), ("mailbox", ecfg.mb)):
+        if cfg.n_buckets_padded % n_dev:
+            raise ValueError(
+                f"sharded path: {n_dev} mesh devices do not divide the "
+                f"{label} tree's {cfg.n_buckets_padded} padded buckets "
+                "— the bucket axis shards as contiguous equal heap "
+                "ranges; use a power-of-two mesh no larger than the "
+                "smaller tree"
+            )
+
+
 def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     """Jit-compiled engine step with the bucket trees sharded over ``mesh``.
 
@@ -159,20 +186,12 @@ def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
     engine, i.e. the same commit schedule the single-chip production path
     uses (bit-identical results — tested in tests/test_parallel.py, the
     analog of the reference's SGX_MODE=SW simulation testing, reference
-    .github/workflows/ci.yaml:15-16).
+    .github/workflows/ci.yaml:15-16). Delayed eviction (``evict_every >
+    1``) composes: fetch-only rounds accumulate into the REPLICATED
+    eviction buffer (see ``_oram_specs``) and the owner-masked flush
+    (:func:`make_sharded_flush`) drains the window.
     """
-    if ecfg.evict_every > 1:
-        # no sharded flush program exists yet: a shard_map'd
-        # engine_flush_step would scatter the full deduplicated target
-        # set into every local shard unmasked (oram_flush is
-        # axis_name-less), corrupting the trees — refuse loudly instead
-        # of accumulating windows that can never drain (the item-1∘2
-        # composition is on the ROADMAP)
-        raise ValueError(
-            "delayed batched eviction (evict_every > 1) is not "
-            "supported on the sharded path yet — use evict_every=1 "
-            "with make_sharded_step"
-        )
+    validate_sharded_geometry(ecfg, mesh)
     specs = engine_state_specs()
     step = _shard_map(
         functools.partial(engine_round_step, ecfg, axis_name=TREE_AXIS),
@@ -182,3 +201,34 @@ def make_sharded_step(ecfg: EngineConfig, mesh: Mesh):
         **_SHARD_MAP_NOCHECK,
     )
     return jax.jit(step, donate_argnums=0)
+
+
+def make_sharded_flush(ecfg: EngineConfig, mesh: Mesh):
+    """Jit-compiled delayed-eviction flush with the trees sharded.
+
+    Same signature and semantics as ``engine_flush_step(ecfg, state)``:
+    drains the accumulated window into both trees. Inside shard_map the
+    dedup + eviction assignment run replicated (the buffer ∪ stash
+    working set is replicated private state) and each chip's
+    scatter+encrypt pass is owner-masked to its contiguous heap range
+    via the same ``_path_scatter`` machinery the sharded round uses —
+    the per-chip write still carries all ``flush_target_slots`` rows
+    (uniform static shape; the leak argument in oram/round.py), but
+    only owned rows land, so the union across the mesh is exactly the
+    single-chip flush.
+    """
+    if ecfg.evict_every <= 1:
+        raise ValueError(
+            "make_sharded_flush: evict_every=1 has no flush program — "
+            "the per-round sharded step already writes back every path"
+        )
+    validate_sharded_geometry(ecfg, mesh)
+    specs = engine_state_specs()
+    flush = _shard_map(
+        functools.partial(engine_flush_step, ecfg, axis_name=TREE_AXIS),
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=specs,
+        **_SHARD_MAP_NOCHECK,
+    )
+    return jax.jit(flush, donate_argnums=0)

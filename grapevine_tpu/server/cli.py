@@ -131,6 +131,22 @@ def build_parser() -> argparse.ArgumentParser:
         "slack)). Watch grapevine_evict_buffer_high_water before "
         "lowering it. Device-owning roles only",
     )
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="bucket-tree shard count across the local device mesh "
+        "(parallel/mesh.py, OPERATIONS.md §22): each of the first N JAX "
+        "devices owns a contiguous heap range of both bucket trees; the "
+        "round gathers over ICI and the delayed-eviction flush "
+        "owner-masks its scatters per chip. Responses, transcripts, and "
+        "logical state are bit-identical at every shard count, and "
+        "journals/checkpoints replay across shard counts (the knob is "
+        "outside the durability fingerprint, like --pipeline-depth). "
+        "Power of two dividing both trees' padded bucket counts; "
+        "requires N visible devices. 1 = single-chip (default). "
+        "Device-owning roles only",
+    )
     p.add_argument("--seed", type=int, default=0, help="engine RNG seed")
     p.add_argument(
         "--identity-seed",
@@ -353,7 +369,7 @@ _TRACE_SLO_FLAGS = {"trace_ring_size", "slo_commit_p99_ms",
 #: silently configure nothing (its engine lives in another process)
 _ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels",
                       "pipeline_depth", "evict_every",
-                      "evict_buffer_slots"}
+                      "evict_buffer_slots", "shards"}
 
 #: fleet-aggregator topology/cadence: only the fleet role scrapes —
 #: any other role supplied --fleet-members would silently aggregate
@@ -491,6 +507,7 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth,
         evict_every=args.evict_every,
         evict_buffer_slots=args.evict_buffer_slots,
+        shards=args.shards,
     )
     identity = None
     if args.identity_seed:

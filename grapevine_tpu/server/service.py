@@ -28,7 +28,15 @@ import grpc
 
 from ..config import GrapevineConfig
 from ..engine.batcher import GrapevineEngine, validate_request
-from ..session import channel as chan
+
+try:
+    from ..session import channel as chan
+except ModuleNotFoundError:
+    # The channel layer needs the 'cryptography' wheel. The engine tier
+    # (server/tier.py) imports this module only for run_expiry_loop and
+    # must keep working without it; constructing the session-terminating
+    # GrapevineServer without the wheel still fails, now at first use.
+    chan = None
 from ..session.chacha import ChallengeRng
 from ..testing.reference import HardProtocolError
 from ..wire import constants as C

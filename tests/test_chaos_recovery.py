@@ -76,6 +76,31 @@ def test_delayed_eviction_kill_trials_recover_bit_identical():
     assert not failures, "\n".join(failures)
 
 
+def test_sharded_flush_kill_trials_recover_bit_identical():
+    """ISSUE-18 chaos coverage: ``--shards 2 --evict-every 2`` runs the
+    child on a 2-device virtual CPU mesh with the owner-masked sharded
+    flush, and kills land at the flush boundaries —
+    ``flush.pre_dispatch`` (flush frame durable, owner-masked scatter
+    undispatched: recovery must replay the flush on the mesh) and
+    ``flush.post_dispatch`` (scatter landed on both shards' HBM ranges,
+    no later frame durable) — plus a mid-accumulation append kill and a
+    randomized timer kill. The oracle is the SINGLE-CHIP serial E=2
+    program, so bit-identical recovery proves the crash contract AND
+    sharded<->single-chip equivalence through a kill-restart cycle at
+    once, with leakmon PASS on the recovered engine."""
+    chaos = _load_chaos()
+
+    args = chaos.parse_args(
+        ["--events", "16", "--evict-every", "2", "--shards", "2",
+         "--seed", "64", "--checkpoint-every", "5"]
+    )
+    failures = chaos.run_trials(0, args, modes=[
+        "flush.pre_dispatch", "flush.post_dispatch",
+        "journal.append.post_fsync", "timer",
+    ])
+    assert not failures, "\n".join(failures)
+
+
 def test_pipelined_kill_trials_recover_bit_identical():
     """PR-10 chaos coverage: ``--pipeline-depth 2`` keeps a round
     mid-flight on the device while the next one journals + fsyncs, and

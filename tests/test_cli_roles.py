@@ -47,6 +47,12 @@ def _check(argv):
     ["--role", "frontend", "--evict-every", "4"],
     ["--role", "frontend", "--evict-every", "1"],
     ["--role", "frontend", "--evict-buffer-slots", "4096"],
+    # the bucket-tree shard count is engine geometry (ISSUE 18): a
+    # frontend supplying it would silently shard nothing — rejected
+    # even at the explicit single-chip value, and on the fleet role
+    ["--role", "frontend", "--shards", "2"],
+    ["--role", "frontend", "--shards", "1"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--shards", "2"],
     # fleet topology/cadence belongs to the fleet role alone (ISSUE 16
     # satellite): any other role supplying --fleet-* would silently
     # aggregate nothing — rejected even at default values
@@ -112,6 +118,12 @@ def test_misapplied_flags_rejected(argv):
      "--evict-every", "1"],
     ["--role", "mono", "--evict-every", "4",
      "--evict-buffer-slots", "4096"],
+    # …and the bucket-tree shard count, alone and composed with the
+    # eviction cadence — the ISSUE-18 pairing (sharded E>1 flush)
+    ["--role", "mono", "--shards", "2"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--shards", "4", "--evict-every", "4"],
+    ["--role", "mono", "--shards", "1"],
     # the fleet role takes its topology/cadence flags + the bind
     # interface (ISSUE 16)
     ["--role", "fleet", "--fleet-members", "127.0.0.1:9464,127.0.0.1:9465"],

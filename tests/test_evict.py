@@ -283,6 +283,36 @@ def test_evict_access_schedule_audit():
     assert out["flush"]["tree_val"] == [2 * 8 * 8]  # t rows, scatters
 
 
+def test_sharded_evict_access_schedule_audit():
+    """ISSUE-18 trace gate (compile-free, always-on): per shard, the
+    sharded fetch round is index-blind and HBM-read-only at the uniform
+    B·(path_len−k) working-set shape, and the sharded flush's scatter
+    ops carry all t rows on every chip (owner-masked lanes drop via
+    out-of-range targets — the static shape never shrinks). The runtime
+    owner-partition claim and its seeded mutant ride -m slow."""
+    from check_tree_cache_oblivious import check_sharded_evict_accounting
+
+    out = check_sharded_evict_accounting(runtime=False)
+    assert out["shards"] == 2
+    assert out["fetch"]["tree_val"] == [6 * 6]  # B·(plen−k) per shard
+    assert out["flush"]["tree_val"] == [2 * 6 * 8]  # all t rows per shard
+
+
+@pytest.mark.slow
+def test_sharded_evict_owner_partition_and_mutant():
+    """Runtime halves of the ISSUE-18 audit, both directions: (a) every
+    bucket the single-chip flush writes is written by exactly its
+    heap-range owner shard and per-shard counts sum to the single-chip
+    count; (b) the seeded unmasked-scatter mutant (shard mask dropped,
+    wrapped local targets) must FAIL the partition check."""
+    from check_tree_cache_oblivious import check_sharded_evict_accounting
+
+    out = check_sharded_evict_accounting()
+    assert sum(out["per_shard_written"]) == out["oracle_written"]
+    with pytest.raises(AssertionError, match="owner partition|diverges"):
+        check_sharded_evict_accounting(_unmasked_scatter=True)
+
+
 def test_evict_buffer_overflow_canary():
     """Directed near-overflow: an explicitly undersized buffer + stash
     must trip the shared sticky overflow counter and surface through
@@ -566,6 +596,16 @@ def test_evict_recursive_schedule_audit():
     from check_tree_cache_oblivious import check_evict_round_accounting
 
     check_evict_round_accounting(recursive=True)
+
+
+@pytest.mark.slow
+def test_sharded_evict_recursive_schedule_audit():
+    """The sharded trace+runtime audit over the recursive-posmap
+    geometry: the replicated inner trees flush axis-free inside every
+    chip's pass while the outer planes owner-partition."""
+    from check_tree_cache_oblivious import check_sharded_evict_accounting
+
+    check_sharded_evict_accounting(recursive=True)
 
 
 @pytest.mark.slow

@@ -52,7 +52,8 @@ MAX_RESTARTS = 60
 def _config(posmap_impl: str | None = None,
             tree_top_cache_levels: int | None = None,
             pipeline_depth: int | None = None,
-            evict_every: int | None = None):
+            evict_every: int | None = None,
+            shards: int | None = None):
     from grapevine_tpu.config import GrapevineConfig
 
     return GrapevineConfig(
@@ -62,6 +63,7 @@ def _config(posmap_impl: str | None = None,
         tree_top_cache_levels=tree_top_cache_levels,
         pipeline_depth=pipeline_depth,
         evict_every=evict_every,
+        shards=shards or 1,
     )
 
 
@@ -196,7 +198,7 @@ def run_child(args) -> int:
     )
     engine = GrapevineEngine(
         _config(args.posmap_impl, args.tree_top_cache_levels,
-                args.pipeline_depth, args.evict_every),
+                args.pipeline_depth, args.evict_every, args.shards),
         seed=ENGINE_SEED, durability=dcfg,
     )
     monitor = EngineLeakMonitor.for_engine(
@@ -237,10 +239,12 @@ def oracle(schedule_seed: int, n_events: int, posmap_impl: str | None = None,
            evict_every: int | None = None):
     """Uninterrupted in-process run: per-seq hashes + final state hash.
 
-    Always serial (pipeline_depth=1): the oracle is the pre-PR-10
-    resolve-before-next-dispatch program, so a ``--pipeline-depth 2``
-    chaos run proves depth-2 recovery bit-identical to the SERIAL ground
-    truth — pipelining equivalence and crash equivalence in one gate."""
+    Always serial (pipeline_depth=1) and single-chip (shards=1): the
+    oracle is the pre-PR-10 resolve-before-next-dispatch program on one
+    device, so a ``--pipeline-depth 2`` or ``--shards N`` chaos run
+    proves the pipelined / mesh-sharded child recovers bit-identical to
+    the SERIAL SINGLE-CHIP ground truth — composition equivalence and
+    crash equivalence in one gate."""
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.engine.checkpoint import state_to_bytes
 
@@ -362,12 +366,24 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
             child_cmd += ["--pipeline-depth", str(args.pipeline_depth)]
         if args.evict_every is not None:
             child_cmd += ["--evict-every", str(args.evict_every)]
+        if args.shards is not None:
+            child_cmd += ["--shards", str(args.shards)]
         base_env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
             JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
         )
         base_env.pop("GRAPEVINE_FAULTS", None)
+        if (args.shards or 1) > 1:
+            # the child needs a mesh: force the virtual CPU device
+            # count (before its jax init) unless the caller already set
+            # one — the ORACLE stays single-chip in this process
+            flags = base_env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                base_env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{args.shards}"
+                ).strip()
         kills = 0
         launch = 0
         while True:
@@ -514,6 +530,18 @@ def parse_args(argv):
                    "E (serial), so trials prove crash recovery, not "
                    "cross-E equivalence (that is tests/test_evict.py's "
                    "logical-content contract). Default = engine auto (1)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="bucket-axis shard count under test (parallel/"
+                   "mesh.py via engine/batcher.py): the child runs the "
+                   "sharded step/flush programs on a virtual CPU mesh "
+                   "(the parent exports the device-count XLA flag), "
+                   "while the ORACLE stays single-chip — so every "
+                   "trial proves crash recovery AND sharded<->single-"
+                   "chip bit-equivalence in one gate (the pipeline-"
+                   "depth discipline). Combine with --evict-every > 1 "
+                   "to land the flush.pre/post_dispatch kills on the "
+                   "owner-masked sharded flush. Default = engine auto "
+                   "(1)")
     p.add_argument("--pipeline-depth", type=int, default=None,
                    choices=[1, 2],
                    help="round-pipeline depth under test (engine/"

@@ -19,7 +19,8 @@ observatory (grapevine_tpu/analysis/costmodel.py, obs/costmon.py):
    cannot catch a planted defect is vacuous.
 3. **Trajectory grading** (``--grade``): replay every banked
    BENCH_trajectory.jsonl A/B line (sort_ab / tree_cache_ab /
-   evict_ab / pipeline_ab, machinery and sweep scopes) and report the
+   evict_ab / sharded_evict_ab / pipeline_ab, machinery and sweep
+   scopes) and report the
    modeled winner next to the measured winner. Agreement is REPORTED
    per config — a disagreement is a finding about the model (or a
    machine regime the bytes model does not price), printed loudly, not
@@ -71,6 +72,13 @@ def run_identity_matrix(verbose: bool = False) -> list:
         if ecfg.evict_every > 1:
             _run(f"{name}/flush", cm.cross_validate_engine_flush, ecfg)
         _run(f"{name}/sweep", cm.cross_validate_sweep, ecfg)
+    # the owner-masked sharded flush (ISSUE 18): shard-local analytic
+    # rows vs the shard_map-traced census, on whatever mesh slice the
+    # process actually has (main() forces >=2 virtual CPU devices when
+    # it owns the jax init)
+    for name, cfg, shards in cm.audit_sharded_flush_configs():
+        _run(f"{name}/s{shards}", cm.cross_validate_sharded_flush,
+             cfg, shards)
     return problems
 
 
@@ -108,6 +116,15 @@ def _parse_cap_b(group_name: str):
     cap = int(group_name.split("cap")[1].split("_")[0])
     b = int(group_name.split("_b")[1])
     return cap, b
+
+
+def _parse_cap_b_s(group_name: str):
+    """'round_cap4096_b64_s2' -> (4096, 64, 2) — the sharded_evict_ab
+    group key (geometry: capacity x batch x mesh width)."""
+    cap = int(group_name.split("cap")[1].split("_")[0])
+    rest = group_name.split("_b")[1]
+    b, s = rest.split("_s")
+    return cap, int(b), int(s)
 
 
 def grade_trajectory(path: str = TRAJECTORY) -> tuple:
@@ -185,6 +202,19 @@ def grade_trajectory(path: str = TRAJECTORY) -> tuple:
                              f"{pr}/sweep/b{bstr}",
                              v["winner"], measured, v["basis"])
 
+        if "sharded_evict_ab" in configs:
+            kinds_seen.add("sharded_evict")
+            ab = configs["sharded_evict_ab"]
+            for gname, arms in ab.get("machinery", {}).items():
+                cap, b, s = _parse_cap_b_s(gname)
+                es = sorted(int(a[1:]) for a in arms if a[1:].isdigit())
+                v = cm.ab_verdict("sharded_evict", scope="machinery",
+                                  cap_n=cap, batch=b, arms=es, shards=s)
+                measured = _measured_winner(arms, "amortized_round_ms")
+                _grade_entry(results, "sharded_evict",
+                             f"{pr}/machinery/{gname}",
+                             v["winner"], measured, v["basis"])
+
         if "pipeline_ab" in configs:
             kinds_seen.add("pipeline")
             ab = configs["pipeline_ab"]
@@ -195,7 +225,8 @@ def grade_trajectory(path: str = TRAJECTORY) -> tuple:
             _grade_entry(results, "pipeline", f"{pr}/pipeline_ab",
                          v["winner"], measured, v["basis"])
 
-    for kind in ("sort", "tree_cache", "evict", "pipeline"):
+    for kind in ("sort", "tree_cache", "evict", "pipeline",
+                 "sharded_evict"):
         if kind not in kinds_seen:
             problems.append(
                 f"banked trajectory has no {kind}_ab line to grade — "
@@ -235,6 +266,16 @@ def main(argv=None) -> int:
     do_grade = args.grade or not args.smoke
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the sharded-flush audit wants a real (if virtual) mesh slice; the
+    # flag only takes effect if jax has not initialized its backend yet
+    # (the check_tree_cache_oblivious.py recipe) — when it has, the
+    # audit degrades to a 1-way mesh rather than skipping
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
     problems: list = []
 
     if do_smoke:

@@ -200,6 +200,46 @@ def audit_oram_flush(allowlist, sort_impl: str, recursive: bool, k: int,
     )
 
 
+def audit_sharded_oram_flush(allowlist, sort_impl: str, recursive: bool,
+                             k: int, ee: int = 2, shards: int = 2):
+    """Taint-audit the owner-masked sharded flush (ISSUE 18): the same
+    ``oram_flush`` wrapped in ``shard_map`` over a bucket-axis mesh.
+    The certified claim extends per chip: every chip's scatter targets
+    derive ONLY from the untainted public window ledger plus its own
+    (public) mesh coordinate — the owner mask narrows which rows LAND,
+    never which rows are DISPATCHED, so the per-chip transcript stays
+    the uniform static-shape drop-mode scatter (the leak argument in
+    parallel/mesh.py make_sharded_flush)."""
+    import jax
+
+    from grapevine_tpu.analysis.oblint import analyze
+    from grapevine_tpu.oram import round as oround
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.parallel.mesh import (
+        _SHARD_MAP_NOCHECK, TREE_AXIS, _oram_specs, _shard_map,
+        make_mesh,
+    )
+
+    cfg = _small_oram_cfg(recursive, k, ee=ee)
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    mesh = make_mesh(jax.devices()[:shards])
+    specs = _oram_specs()
+    fn = _shard_map(
+        lambda st: oround.oram_flush(cfg, st, TREE_AXIS,
+                                     sort_impl=sort_impl),
+        mesh=mesh, in_specs=(specs,), out_specs=specs,
+        **_SHARD_MAP_NOCHECK,
+    )
+    return analyze(
+        fn,
+        {"state": state},
+        secrets=oround.OBLINT_SECRETS,
+        allowlist=allowlist,
+        name=f"sharded_oram_flush/{sort_impl}_"
+             f"{'rec' if recursive else 'flat'}_k{k}_e{ee}_s{shards}",
+    )
+
+
 def audit_oram_round(allowlist, occ_impl: str, sort_impl: str,
                      recursive: bool, k: int, ee: int = 1):
     """Taint-audit the library sub-rounds standalone: oram_round (and
@@ -430,6 +470,23 @@ def run_audit(combos, allowlist=None, with_census="first",
                     allowlist, sort_impl=srt,
                     recursive=(pmi == "recursive"), k=k, ee=ee,
                 ))
+                import jax
+
+                if len(jax.devices()) >= 2:
+                    # the mesh composition of the same flush (ISSUE
+                    # 18): owner-masked scatter on a 2-shard mesh
+                    absorb(audit_sharded_oram_flush(
+                        allowlist, sort_impl=srt,
+                        recursive=(pmi == "recursive"), k=k, ee=ee,
+                        shards=2,
+                    ))
+                else:  # pragma: no cover - bootstrap in main()
+                    problems.append(
+                        "sharded flush audit needs >= 2 devices (got "
+                        "1) — run standalone (main() forces a virtual "
+                        "2-device CPU mesh) or under the test "
+                        "harness's 8-device conftest"
+                    )
     if with_census:
         census_combos = combos if with_census == "all" else combos[:1]
         for vp, srt, pmi, k, ee in census_combos:
@@ -457,6 +514,17 @@ def check_allowlist_reachability(hits: dict) -> list:
 def main(argv=None) -> int:
     import argparse
     import itertools
+
+    # the sharded flush audit traces a 2-device shard_map: force a
+    # virtual CPU mesh if jax has not initialized yet (standalone
+    # invocation; in-process the test conftest already forces 8)
+    if ("jax" not in sys.modules
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",

@@ -227,6 +227,56 @@ def audit_oram_flush(allowlist, log2_blocks: int, sort_impl: str,
     )
 
 
+def audit_sharded_oram_flush(allowlist, log2_blocks: int, sort_impl: str,
+                             recursive: bool, k: int, ee: int,
+                             shards: int):
+    """Interval-audit the owner-masked sharded flush (ISSUE 18): the
+    same ``oram_flush`` program wrapped in ``shard_map`` over a
+    ``shards``-device bucket-axis mesh. New arithmetic vs the
+    single-chip flush: ``axis_index`` (bounded [0, shards-1] by the
+    rangelint mesh rule) and the per-chip rebase in ``_path_scatter``
+    — non-owned lanes wrap mod 2^32 by construction and land on the
+    drop sentinel, a reviewed RANGE_ALLOWLIST pair. Trace-only, like
+    every audit here."""
+    import jax
+
+    from grapevine_tpu.analysis.rangelint import analyze_ranges
+    from grapevine_tpu.oram import posmap as pmod
+    from grapevine_tpu.oram import round as oround
+    from grapevine_tpu.oram.path_oram import (
+        RANGELINT_BOUNDS as tree_bounds, init_oram,
+    )
+    from grapevine_tpu.parallel.mesh import (
+        _SHARD_MAP_NOCHECK, TREE_AXIS, _oram_specs, _shard_map,
+        make_mesh,
+    )
+
+    cfg = _oram_cfg(log2_blocks, recursive, k, ee=ee)
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    mesh = make_mesh(jax.devices()[:shards])
+    specs = _oram_specs()
+    fn = _shard_map(
+        lambda st: oround.oram_flush(cfg, st, TREE_AXIS,
+                                     sort_impl=sort_impl),
+        mesh=mesh, in_specs=(specs,), out_specs=specs,
+        **_SHARD_MAP_NOCHECK,
+    )
+    bounds = {
+        **tree_bounds(cfg, prefix="state"),
+        **pmod.RANGELINT_BOUNDS(cfg, prefix="state.posmap"),
+    }
+    bounds = {k2: v for k2, v in bounds.items()
+              if not k2.startswith("pm_state")}
+    return analyze_ranges(
+        fn,
+        {"state": state},
+        bounds=bounds,
+        allowlist=allowlist,
+        name=f"sharded_oram_flush/2^{log2_blocks}_{sort_impl}_"
+             f"{'rec' if recursive else 'flat'}_k{k}_e{ee}_s{shards}",
+    )
+
+
 def audit_oram_round(allowlist, log2_blocks: int, occ_impl: str,
                      sort_impl: str, recursive: bool, k: int,
                      ee: int = 1):
@@ -376,6 +426,24 @@ def run_audit(combos, geometry: int, allowlist=None, verbose=False,
                     allowlist, geometry, sort_impl=srt,
                     recursive=(pmi == "recursive"), k=k, ee=ee,
                 ))
+                import jax
+
+                if len(jax.devices()) >= 2:
+                    # the mesh composition of the same flush (ISSUE
+                    # 18): 2 shards is where every sharded-only lane
+                    # (axis_index, the _path_scatter rebase) exists
+                    absorb(audit_sharded_oram_flush(
+                        allowlist, geometry, sort_impl=srt,
+                        recursive=(pmi == "recursive"), k=k, ee=ee,
+                        shards=2,
+                    ))
+                else:  # pragma: no cover - bootstrap in main()
+                    problems.append(
+                        "sharded flush audit needs >= 2 devices (got "
+                        "1) — run standalone (main() forces a virtual "
+                        "2-device CPU mesh) or under the test "
+                        "harness's 8-device conftest"
+                    )
     return problems, hits
 
 
@@ -411,6 +479,17 @@ def certify_design_point(log2_records: int) -> "tuple[list, str]":
 
 def main(argv=None) -> int:
     import argparse
+
+    # the sharded flush audit traces a 2-device shard_map: force a
+    # virtual CPU mesh if jax has not initialized yet (standalone
+    # invocation; in-process the test conftest already forces 8)
+    if ("jax" not in sys.modules
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -449,6 +528,17 @@ def main(argv=None) -> int:
         )
         print(rep.summary())
         problems.extend(f"{rep.name}: {f}" for f in rep.findings)
+        import jax
+
+        if len(jax.devices()) >= 2:
+            # always-on sharded lane coverage (trace-only): the
+            # owner-masked flush's rebase arithmetic at toy geometry
+            rep = audit_sharded_oram_flush(
+                RANGE_ALLOWLIST, 5, sort_impl=srt,
+                recursive=(pmi == "recursive"), k=k, ee=ee, shards=2,
+            )
+            print(rep.summary())
+            problems.extend(f"{rep.name}: {f}" for f in rep.findings)
         dp, refusal = certify_design_point(DESIGN_POINT)
         problems.extend(dp)
         if refusal:

@@ -436,6 +436,338 @@ def check_evict_round_accounting(
     return out
 
 
+def _trace_sharded(cfg, what, mesh, idxs=None, b=0):
+    """Jaxpr of one SHARDED fetch round or flush: the oram program wrapped
+    in the same shard_map geometry the engine uses (parallel/mesh.py),
+    so ``walk_eqns`` recurses into the shard body where every tree-plane
+    operand carries its SHARD-LOCAL shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.oram.round import oram_flush, oram_round
+    from grapevine_tpu.parallel.mesh import (
+        _SHARD_MAP_NOCHECK, TREE_AXIS, _oram_specs, _shard_map,
+    )
+
+    state = jax.eval_shape(lambda: init_oram(cfg, jax.random.PRNGKey(0)))
+    specs = _oram_specs()
+    if what == "flush":
+        fn = _shard_map(
+            lambda st: oram_flush(cfg, st, TREE_AXIS),
+            mesh=mesh, in_specs=(specs,), out_specs=specs,
+            **_SHARD_MAP_NOCHECK,
+        )
+        return jax.make_jaxpr(fn)(state)
+    cidxs = jnp.asarray(idxs)
+    recursive = cfg.posmap is not None
+
+    def apply_batch(vals0, present0):
+        return jnp.sum(vals0, axis=1), vals0, present0
+
+    def run(st, nl, dl, pm_nl, pm_dl):
+        return oram_round(
+            cfg, st, cidxs, nl, dl, apply_batch, axis_name=TREE_AXIS,
+            pm_new_leaves=pm_nl if recursive else None,
+            pm_dummy_leaves=pm_dl if recursive else None,
+        )
+
+    lf = jax.ShapeDtypeStruct((b,), jnp.uint32)
+    fn = _shard_map(
+        run, mesh=mesh, in_specs=(specs, P(), P(), P(), P()),
+        out_specs=(specs, P(), P()), **_SHARD_MAP_NOCHECK,
+    )
+    return jax.make_jaxpr(fn)(state, lf, lf, lf, lf)
+
+
+def _local_tree_planes(cfg, n_shards: int) -> dict:
+    """Shard-LOCAL plane declarations: the bucket axis shards as
+    contiguous equal heap ranges, so each chip's tree/nonce operands are
+    the full planes at ``n / n_shards`` rows; cache planes are
+    replicated private state and keep their full shape."""
+    planes = _tree_planes(cfg)
+    out = {}
+    for name, (shape, div) in planes.items():
+        if name.startswith(("tree_", "nonces")):
+            shape = (shape[0] // n_shards,) + tuple(shape[1:])
+        out[name] = (shape, div)
+    return out
+
+
+def _unmasked_scatter_mutant(orig):
+    """The seeded defect the sharded audit exists to catch: a sharded
+    ``_path_scatter`` that keeps the dedup owner mask but DROPS the
+    shard-ownership mask — every chip writes every target into its local
+    plane at wrapped indices instead of dropping non-owned lanes, so the
+    union across the mesh is no longer the single-chip flush."""
+    import jax
+    import jax.numpy as jnp
+
+    def mutant(tree, path_b, new_vals, axis_name, owner=None):
+        if axis_name is None:
+            return orig(tree, path_b, new_vals, axis_name, owner)
+        n_local = tree.shape[0]
+        u32 = jnp.uint32
+        base = (jax.lax.axis_index(axis_name) * n_local).astype(u32)
+        loc = (path_b - base) % u32(n_local)  # wraps instead of dropping
+        if owner is not None:
+            loc = jnp.where(owner, loc, u32(n_local))
+        return tree.at[loc].set(new_vals, mode="drop", unique_indices=True)
+
+    return mutant
+
+
+def check_sharded_evict_accounting(
+    b: int = 6, height: int = 7, k: int = 2, window: int = 2,
+    shards: int = 2, verbose: bool = False, recursive: bool = False,
+    runtime: bool = True, _unmasked_scatter: bool = False,
+) -> dict:
+    """ISSUE-18 extension: the delayed-eviction schedule's accounting for
+    the SHARDED program (parallel/mesh.py make_sharded_step/flush).
+
+    Trace-level, per shard (walk_eqns recurses into the shard_map body,
+    where operands carry shard-local shapes):
+
+    1. **Per-shard fetch rounds are HBM-read-only at the uniform
+       working-set shape.** Each chip's fetch round is index-blind
+       (identical census across adversarial index sets, zero
+       data-dependent control flow), its local tree-plane GATHER ops
+       each carry exactly ``B·(path_len−k)`` rows — the full working-set
+       shape, non-owned lanes masked, so per-chip row counts are a pure
+       function of geometry, never of contents or ownership — and it
+       contains ZERO scatters on any local tree/nonce plane.
+    2. **Per-shard flush scatters carry exactly ``t`` rows.** Each
+       chip's flush SCATTER ops carry all ``t = flush_target_slots``
+       rows (the owner mask drops non-owned lanes via out-of-range
+       targets — the static shape never shrinks), with ZERO local
+       tree-plane gathers.
+
+    Runtime, on a real mesh (the partition claim — where "sums to
+    exactly the single-chip write set" lives):
+
+    3. **Owner partition.** Running the window + flush sharded and
+       single-chip from the same state: every bucket row the single-chip
+       flush writes is written by EXACTLY ONE shard (its heap-range
+       owner), the per-shard written-row counts sum to the single-chip
+       count, and the assembled sharded state equals the single-chip
+       state bit for bit.
+
+    ``_unmasked_scatter=True`` seeds the control defect (shard mask
+    dropped from the flush scatter) — the runtime partition check must
+    FAIL; tests/test_evict.py pins both directions. ``runtime=False``
+    runs only the (compile-free) trace claims — the always-on tier-1
+    shape; the runtime partition + mutant ride ``-m slow`` and the
+    standalone tool.
+    """
+    import jax
+
+    from grapevine_tpu.oram.round import flush_target_slots
+    from grapevine_tpu.parallel.mesh import make_mesh
+
+    n_shards = min(shards, len(jax.devices()))
+    mesh = make_mesh(jax.devices()[:n_shards])
+    cfg = _evict_cfg(b, height, k, window, recursive)
+    plen = cfg.path_len
+    want_fetch = b * (plen - k)
+    want_flush = flush_target_slots(cfg)
+    n_local = cfg.n_buckets_padded // n_shards
+    assert cfg.n_buckets_padded % n_shards == 0
+    # shape-based attribution needs the local planes unambiguous: the
+    # compacted flush working set is (t, ·) and the buffer is
+    # (evict_buffer_slots, ·) — neither may coincide with a local tree
+    # plane's (n/shards, ·) or private scatters count as tree traffic
+    assert want_flush != n_local and cfg.evict_buffer_slots != n_local, (
+        f"audit geometry ambiguity: t={want_flush} / buffer="
+        f"{cfg.evict_buffer_slots} vs n_local={n_local} — pick b/height "
+        "so the shard-local plane shape is unique"
+    )
+
+    # -- 1. per-shard fetch round: index-blind + read-only --------------
+    censuses = {
+        iname: _census(_trace_sharded(cfg, "round", mesh, idxs, b))
+        for iname, idxs in _index_sets(cfg, b).items()
+    }
+    base_name, base = next(iter(censuses.items()))
+    for iname, c in censuses.items():
+        assert c == base, (
+            f"shards={n_shards} E={window}: sharded fetch round traces "
+            f"a DIFFERENT program for index set {iname!r} vs "
+            f"{base_name!r}: {(c - base) + (base - c)}"
+        )
+    n_control = sum(base[p] for p in _CONTROL_PRIMS)
+    assert n_control == 0, (
+        f"shards={n_shards} E={window}: data-dependent control flow in "
+        f"the sharded fetch round "
+        f"({ {p: base[p] for p in _CONTROL_PRIMS if base[p]} })"
+    )
+    lplanes = _local_tree_planes(cfg, n_shards)
+    rows = _shared_plane_rows(
+        _trace_sharded(cfg, "round", mesh,
+                       _index_sets(cfg, b)["mixed_dups"], b),
+        lplanes,
+    )
+    tree_planes = ["tree_idx", "tree_val", "nonces"]
+    if recursive:
+        tree_planes.append("tree_leaf")
+    fetch_acct = {}
+    for pname in tree_planes:
+        moved = rows[pname]
+        gathers = [r for op, r in moved if op == "gather"]
+        scatters = [(op, r) for op, r in moved if op != "gather"]
+        assert not scatters, (
+            f"shards={n_shards} E={window}: per-shard fetch round "
+            f"SCATTERS to local {pname} ({scatters}) — the sharded "
+            "steady-state round must be read-only on every chip's HBM"
+        )
+        assert gathers and all(r == want_fetch for r in gathers), (
+            f"shards={n_shards} E={window}: per-shard {pname} fetch "
+            f"gathers move {sorted(set(gathers))} rows — want the "
+            f"uniform working-set shape B·(path_len−k) = {want_fetch} "
+            "on every chip (non-owned lanes masked, never absent)"
+        )
+        fetch_acct[pname] = sorted(set(gathers))
+
+    # -- 2. per-shard flush: t-row scatters, no local tree reads --------
+    frows = _shared_plane_rows(
+        _trace_sharded(cfg, "flush", mesh), lplanes
+    )
+    flush_acct = {}
+    for pname in tree_planes:
+        moved = frows[pname]
+        gathers = [r for op, r in moved if op == "gather"]
+        scatters = [r for op, r in moved if op != "gather"]
+        assert not gathers, (
+            f"shards={n_shards} E={window}: sharded flush GATHERS from "
+            f"local {pname} — the window's live rows were already "
+            "pulled at fetch time"
+        )
+        assert scatters and all(r == want_flush for r in scatters), (
+            f"shards={n_shards} E={window}: per-shard {pname} flush "
+            f"scatters move {sorted(set(scatters))} rows — want all "
+            f"t = {want_flush} rows on every chip (the owner mask drops "
+            "lanes via out-of-range targets; the static shape is the "
+            "leak argument and never shrinks)"
+        )
+        flush_acct[pname] = sorted(set(scatters))
+
+    if not runtime:
+        out = {
+            "fetch": fetch_acct, "flush": flush_acct,
+            "want_fetch_rows": want_fetch, "want_flush_rows": want_flush,
+            "shards": n_shards,
+        }
+        if verbose:
+            print(f"sharded E={window} k={k} shards={n_shards} "
+                  f"({'recursive' if recursive else 'flat'}, trace "
+                  f"only): {out}")
+        return out
+
+    # -- 3. runtime owner partition (+ the seeded-mutant hook) ----------
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from grapevine_tpu.oram import round as round_mod
+    from grapevine_tpu.oram.path_oram import init_oram
+    from grapevine_tpu.parallel.mesh import (
+        _SHARD_MAP_NOCHECK, TREE_AXIS, _oram_specs, _shard_map,
+    )
+
+    def apply_batch(vals0, present0):
+        return jnp.sum(vals0, axis=1), vals0, present0
+
+    def run_round(axis, st, idxs, nl, dl, pm_nl, pm_dl):
+        return round_mod.oram_round(
+            cfg, st, idxs, nl, dl, apply_batch, axis_name=axis,
+            pm_new_leaves=pm_nl if recursive else None,
+            pm_dummy_leaves=pm_dl if recursive else None,
+        )
+
+    specs = _oram_specs()
+    s_round = jax.jit(_shard_map(
+        functools.partial(run_round, TREE_AXIS),
+        mesh=mesh, in_specs=(specs, P(), P(), P(), P(), P()),
+        out_specs=(specs, P(), P()), **_SHARD_MAP_NOCHECK,
+    ))
+    s_flush = jax.jit(_shard_map(
+        lambda st: round_mod.oram_flush(cfg, st, TREE_AXIS),
+        mesh=mesh, in_specs=(specs,), out_specs=specs,
+        **_SHARD_MAP_NOCHECK,
+    ))
+    one_round = jax.jit(functools.partial(run_round, None))
+    one_flush = jax.jit(lambda st: round_mod.oram_flush(cfg, st, None))
+
+    rng = np.random.default_rng(5)
+    st_s = st_1 = init_oram(cfg, jax.random.PRNGKey(7))
+    for _ in range(window):
+        idxs = rng.integers(0, cfg.blocks + 1, b).astype(np.uint32)
+        draws = [rng.integers(0, cfg.leaves, b).astype(np.uint32)
+                 for _ in range(4)]
+        st_s, out_s, tr_s = s_round(st_s, idxs, *draws)
+        st_1, out_1, tr_1 = one_round(st_1, idxs, *draws)
+        np.testing.assert_array_equal(np.asarray(tr_s), np.asarray(tr_1))
+    pre = jax.tree.map(np.asarray, st_1)
+    orig_scatter = round_mod._path_scatter
+    if _unmasked_scatter:
+        round_mod._path_scatter = _unmasked_scatter_mutant(orig_scatter)
+    try:
+        post_s = jax.tree.map(np.asarray, s_flush(st_s))
+    finally:
+        round_mod._path_scatter = orig_scatter
+    post_1 = jax.tree.map(np.asarray, one_flush(st_1))
+
+    # every flush rewrites its targets' nonces, so changed nonce rows ≡
+    # written buckets; the assembled sharded planes concatenate each
+    # chip's local writes in heap order, so shard s's slice holds
+    # exactly what shard s wrote
+    def _written(post):
+        return np.nonzero(
+            (post.nonces != pre.nonces).any(axis=1)
+        )[0]
+
+    oracle_rows = set(_written(post_1).tolist())
+    per_shard, union = [], set()
+    for s in range(n_shards):
+        lo, hi = s * n_local, (s + 1) * n_local
+        ch = {
+            int(r) + lo
+            for r in np.nonzero(
+                (post_s.nonces[lo:hi] != pre.nonces[lo:hi]).any(axis=1)
+            )[0]
+        }
+        assert all(lo <= r < hi for r in ch)
+        per_shard.append(len(ch))
+        union |= ch
+    assert sum(per_shard) == len(oracle_rows) and union == oracle_rows, (
+        f"shards={n_shards} E={window}: owner partition violated — "
+        f"per-shard written rows {per_shard} (sum {sum(per_shard)}) vs "
+        f"the single-chip flush's {len(oracle_rows)} written rows; "
+        "every written bucket must be written by exactly its heap-range "
+        "owner and the union must be the single-chip write set"
+    )
+    for name in ("tree_idx", "tree_val", "nonces", "tree_leaf"):
+        np.testing.assert_array_equal(
+            getattr(post_s, name), getattr(post_1, name),
+            err_msg=f"shards={n_shards} E={window}: sharded flush "
+            f"diverges from single-chip on {name}",
+        )
+
+    out = {
+        "fetch": fetch_acct, "flush": flush_acct,
+        "want_fetch_rows": want_fetch, "want_flush_rows": want_flush,
+        "per_shard_written": per_shard,
+        "oracle_written": len(oracle_rows),
+        "shards": n_shards,
+    }
+    if verbose:
+        print(f"sharded E={window} k={k} shards={n_shards} "
+              f"({'recursive' if recursive else 'flat'}): {out}")
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -443,6 +775,14 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--height", type=int, default=5)
     args = ap.parse_args(argv)
+    if "jax" not in sys.modules:
+        # the sharded audit needs a real (if simulated) multi-device
+        # mesh; standalone runs get one before the backend initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
     for recursive in (False, True):
         out = check_tree_cache_schedule(
             b=args.batch, height=args.height, verbose=True,
@@ -456,10 +796,25 @@ def main(argv=None) -> int:
                                            recursive=recursive)
         print(f"[check_tree_cache_oblivious] evict schedule "
               f"(recursive={recursive}): OK")
+    for recursive in (False, True):
+        out = check_sharded_evict_accounting(verbose=True,
+                                             recursive=recursive)
+        print(f"[check_tree_cache_oblivious] sharded evict schedule "
+              f"(recursive={recursive}): OK")
+    try:
+        check_sharded_evict_accounting(_unmasked_scatter=True)
+    except AssertionError as exc:
+        print("[check_tree_cache_oblivious] seeded unmasked-scatter "
+              f"mutant: CAUGHT ({str(exc)[:72]}...)")
+    else:
+        print("[check_tree_cache_oblivious] FAIL: seeded unmasked-"
+              "scatter mutant passed the sharded partition audit")
+        return 1
     print("[check_tree_cache_oblivious] PASS: cached round is index-blind "
           "and HBM path traffic is exactly B·(path_len−k) rows per plane; "
-          "delayed-eviction fetch rounds are HBM-read-only and each flush "
-          "writes exactly the E-round window")
+          "delayed-eviction fetch rounds are HBM-read-only, each flush "
+          "writes exactly the E-round window, and the sharded flush "
+          "owner-partitions that window across the mesh")
     return 0
 
 

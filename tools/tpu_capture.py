@@ -837,6 +837,94 @@ def stage_evict_perf(cap, args):
     cap.emit("evict_perf", **out)
 
 
+def stage_sharded_perf(cap, args):
+    """Owner-masked sharded flush under load on the real mesh (ISSUE
+    18; the ROADMAP item-1 composition number). Same methodology as
+    evict_perf — a steady open-loop stream through the production
+    scheduler per ``evict_every`` arm — but the engine runs
+    ``shards > 1``: fetch rounds gather sharded tree ranges per chip
+    and the batched flush's scatter+encrypt pass is owner-masked along
+    the bucket axis, so each chip writes only its owned HBM rows.
+    Banked per arm: achieved throughput, commit p50/p99, bubble ratio
+    under load. The pair (throughput_ratio_e4_over_e1 here vs the
+    single-chip number from evict_perf) is what decides whether the
+    read-mostly cadence survives composition with the mesh on real
+    ICI, or the replicated-plane psums eat the flush savings.
+
+    Shard count: the largest power of two <= device count (capped at
+    4, the campaign grid's edge); a single-device host banks an
+    explicit skip instead of silently measuring shards=1."""
+    import jax
+    import numpy as np
+
+    from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.load import (
+        ScenarioRunner,
+        calibrate_unloaded_round,
+        steady_poisson,
+    )
+    from grapevine_tpu.obs.tracer import RoundTracer
+    from grapevine_tpu.server.scheduler import BatchScheduler
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        cap.emit("sharded_perf",
+                 skipped=f"1 device visible (mesh needs >= 2); "
+                         "re-run on a pod slice or with "
+                         "XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=N")
+        return
+    shards = 4 if n_dev >= 4 else 2
+    cl, b, dur = (14, 16, 4.0) if args.quick else (18, 256, 10.0)
+    out = {"capacity_log2": cl, "batch": b, "shards": shards,
+           "n_devices": n_dev}
+    est = None
+    for ee in (1, 4):
+        cfg = GrapevineConfig(
+            max_messages=1 << cl, max_recipients=1 << 10,
+            batch_size=b, evict_every=ee, shards=shards,
+        )
+        engine = GrapevineEngine(cfg)
+        # calibrate EVERY arm (warms each arm's own compile); the
+        # FIRST arm's estimate sets the offered rate so both arms see
+        # the same absolute stream (the evict_perf discipline)
+        t_round, est_arm, _ = calibrate_unloaded_round(
+            engine, 1_700_000_000)
+        if est is None:
+            est = est_arm
+            out["calibrated_round_ms"] = round(t_round * 1e3, 2)
+        tracer = RoundTracer(capacity=2048,
+                             registry=engine.metrics.registry)
+        engine.attach_tracer(tracer)
+        sched = BatchScheduler(engine, clock=lambda: 1_700_000_000)
+        try:
+            runner = ScenarioRunner(sched, n_idents=64,
+                                    settle_timeout_s=180.0)
+            res = runner.run(steady_poisson(0.6 * est, dur, seed=31))
+        finally:
+            sched.close()
+            engine.close()
+        trace = tracer.chrome_trace()
+        s = res.summary()
+        h = engine.health()
+        out[f"e{ee}"] = {
+            "achieved_ops_per_sec": s.get("achieved_ops_per_sec"),
+            "p99_commit_ms": s.get("p99_commit_ms"),
+            "p50_commit_ms": s.get("p50_commit_ms"),
+            "bubble_ratio_under_load":
+                trace["otherData"]["bubble_ratio"],
+            "rounds": trace["otherData"]["rounds_recorded_total"],
+            "stash_overflow": h["stash_overflow"],
+            "evict_buffer_occupancy": h.get("evict_buffer_occupancy"),
+        }
+    e1, e4 = out["e1"], out["e4"]
+    if e1.get("achieved_ops_per_sec") and e4.get("achieved_ops_per_sec"):
+        out["throughput_ratio_e4_over_e1"] = round(
+            e4["achieved_ops_per_sec"] / e1["achieved_ops_per_sec"], 3)
+    cap.emit("sharded_perf", **out)
+
+
 def stage_cost_calibrate(cap, args):
     """Fit the cost observatory's achieved-bandwidth constant on real
     silicon and pre-rank the deferred ``auto`` knob decisions (PR 17).
@@ -961,6 +1049,10 @@ STAGES = [
     # the E A/B + flush-overlap bubble is the ROADMAP-item-1 decision
     # number that settles the evict_every auto (PR 15)
     ("evict_perf", stage_evict_perf, 1200),
+    # sharded_perf right after evict_perf: the same E A/B replayed on
+    # the device mesh (owner-masked flush; ISSUE 18) — the pair of
+    # throughput ratios is the ROADMAP item-1 composition number
+    ("sharded_perf", stage_sharded_perf, 1200),
     # cost_calibrate right after the decision stages it pre-ranks:
     # same geometry family (cached compiles), and the fitted
     # GRAPEVINE_COST_GBPS constant turns the /metrics roofline
