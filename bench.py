@@ -2262,6 +2262,145 @@ def bench_fleet_loopback(smoke):
     return out
 
 
+def bench_failover_ab(smoke):
+    """Config: hot-standby failover — measured RTO vs durable-tail
+    length (ISSUE 19 acceptance: RTO ≤ tail-replay of one checkpoint
+    interval, RPO 0 for durable frames).
+
+    One primary engine ships its sealed journal to an in-process
+    ``StandbyReplica`` over the real socket transport
+    (engine/replication.py). The standby catches up live; then the
+    link is cut, the primary appends a controlled durable tail of
+    exactly ``tail_frames`` journal records past the standby's applied
+    seq, and ``promote()`` is timed: fence plant + tail drain + pending
+    flush completion + fsync. Three tails — empty (pure fencing floor),
+    E·4 (a few flush windows), and one full checkpoint interval (the
+    worst legal tail: any longer and the standby would bootstrap from
+    the next checkpoint instead). RPO is asserted, not claimed: the
+    promoted state must hash bit-identical to the dead primary's.
+
+    ``tail_frames`` (the checkpoint interval) is the geometry key:
+    trajectory lines at different intervals are different experiments,
+    never graded against each other (tools/check_perf_regression.py)."""
+    import hashlib as _hashlib
+    import os
+    import tempfile as _tempfile
+
+    from grapevine_tpu.config import DurabilityConfig, GrapevineConfig
+    from grapevine_tpu.engine.batcher import GrapevineEngine
+    from grapevine_tpu.engine.checkpoint import state_to_bytes
+    from grapevine_tpu.engine.replication import JournalShipper, StandbyReplica
+    from grapevine_tpu.load.harness import identity_pool
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    batch = 4
+    evict_every = 2
+    ckpt_interval = 12 if smoke else 32
+    cfg = GrapevineConfig(
+        max_messages=64, max_recipients=8, mailbox_cap=4,
+        batch_size=batch, stash_size=64, bucket_cipher_rounds=0,
+        evict_every=evict_every,
+    )
+    idents = identity_pool(8)
+
+    def _reqs(i):
+        return [
+            QueryRequest(
+                request_type=C.REQUEST_TYPE_CREATE,
+                auth_identity=idents[(i + j) % 8],
+                auth_signature=b"\x01" * C.SIGNATURE_SIZE,
+                record=RequestRecord(
+                    msg_id=C.ZERO_MSG_ID,
+                    recipient=idents[(i + j + 1) % 8],
+                    payload=bytes([(i + j) & 0xFF]) * C.PAYLOAD_SIZE))
+            for j in range(batch)
+        ]
+
+    tails = {
+        "rto_empty_tail_ms": 0,
+        "rto_e4_tail_ms": evict_every * 4,
+        "rto_full_tail_ms": ckpt_interval,
+    }
+    out = {"tail_frames": ckpt_interval, "evict_every": evict_every,
+           "rpo_frames": 0}
+    for metric, tail in tails.items():
+        with _tempfile.TemporaryDirectory(prefix="bench-failover-") as root:
+            pdir = os.path.join(root, "primary")
+            sdir = os.path.join(root, "standby")
+            os.makedirs(pdir)
+            os.makedirs(sdir)
+            # replication's standing requirement: a shared root seal key
+            key = bytes(range(32))
+            for d in (pdir, sdir):
+                with open(os.path.join(d, "root.key"), "wb") as fh:
+                    fh.write(key)
+                os.chmod(os.path.join(d, "root.key"), 0o600)
+            # manual checkpoint control: the interval IS the experiment
+            big = 1 << 20
+            primary = GrapevineEngine(cfg, seed=7, durability=DurabilityConfig(
+                state_dir=pdir, checkpoint_every_rounds=big,
+                journal_fsync_every=1))
+            replica = StandbyReplica(cfg, seed=7, durability=DurabilityConfig(
+                state_dir=sdir, checkpoint_every_rounds=big,
+                journal_fsync_every=1))
+            port = replica.listen()
+            shipper = JournalShipper(primary, f"127.0.0.1:{port}")
+            shipper.start()
+            # live catch-up phase: a few warm rounds through the wire
+            now = NOW
+            for i in range(4):
+                primary.handle_queries(_reqs(i), now)
+                now += 1
+            deadline = time.monotonic() + 30.0
+            while (replica.dm.applied_seq < primary.durability.seq
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert replica.dm.applied_seq == primary.durability.seq, (
+                f"standby never caught up: {replica.dm.applied_seq} < "
+                f"{primary.durability.seq}"
+            )
+            # cut the link, then append exactly ``tail`` durable frames
+            shipper.close()
+            i = 4
+            while primary.durability.seq - replica.dm.applied_seq < tail:
+                primary.handle_queries(_reqs(i), now)
+                now += 1
+                i += 1
+            dead_seq = primary.durability.seq
+            dead_hash = _hashlib.sha256(
+                state_to_bytes(primary.ecfg, primary.state)
+            ).hexdigest()
+            primary.close()
+            info = replica.promote(primary_state_dir=pdir)
+            live_hash = _hashlib.sha256(
+                state_to_bytes(replica.engine.ecfg, replica.engine.state)
+            ).hexdigest()
+            # RPO 0 for durable frames, bit for bit — asserted inside
+            # the config so a regression fails the bench, not just a
+            # number drifting
+            assert replica.dm.applied_seq == dead_seq, (
+                f"promoted replica at seq {replica.dm.applied_seq}, "
+                f"primary died at {dead_seq}"
+            )
+            assert live_hash == dead_hash, (
+                "promoted state is not bit-identical to the dead primary"
+            )
+            assert info["drained_frames"] >= tail - evict_every, (
+                f"tail drain too short: {info['drained_frames']} < ~{tail}"
+            )
+            out[metric] = round(info["rto_seconds"] * 1e3, 2)
+            replica.close()
+    assert out["rto_full_tail_ms"] < 60_000, (
+        f"full-interval tail replay blew the RTO budget: {out}"
+    )
+    print(f"[bench]   failover_ab: rto empty/{tails['rto_e4_tail_ms']}f/"
+          f"{out['tail_frames']}f = {out['rto_empty_tail_ms']}/"
+          f"{out['rto_e4_tail_ms']}/{out['rto_full_tail_ms']} ms "
+          f"(rpo 0, bit-identical)", file=sys.stderr, flush=True)
+    return out
+
+
 # Headline config FIRST: if the run later hits a budget wall or the
 # driver's own timeout, the metric that matters is already captured
 # (VERDICT r3, next-round #1b).
@@ -2286,6 +2425,7 @@ CONFIGS = [
     ("pipeline_ab", bench_pipeline_ab),
     ("load_scenarios", bench_load_scenarios),
     ("fleet_loopback", bench_fleet_loopback),
+    ("failover_ab", bench_failover_ab),
 ]
 
 

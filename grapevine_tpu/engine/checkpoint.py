@@ -551,6 +551,57 @@ class DurabilityManager:
         self.note_applied_seq(seq)
         return seq
 
+    def append_raw_frame(self, seq: int, frame: bytes) -> int:
+        """Follower path (engine/replication.py): persist one shipped
+        journal frame verbatim. Counts in the records telemetry exactly
+        like a locally encoded record; the caller notes the applied seq
+        only after the device apply succeeds."""
+        seq = self.journal.append_raw(seq, frame)
+        if self._c_records is not None:
+            self._c_records.inc()
+        return seq
+
+    def install_checkpoint(self, seq: int, blob: bytes):
+        """Standby bootstrap: persist a primary-shipped sealed
+        checkpoint and re-base the local journal at it. The blob goes
+        through the normal load path (seal + geometry fingerprint +
+        payload seq) before anything is re-based, so a cross-knob or
+        tampered checkpoint refuses with the standard fingerprint
+        error; returns the loaded EngineState."""
+        path = checkpoint_path(self.dcfg.state_dir, seq)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            write_all(fd, blob)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        dfd = os.open(self.dcfg.state_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        got_seq, state = load_checkpoint(path, self.root_key, self.ecfg)
+        if got_seq != seq:
+            raise CheckpointError(
+                f"{path}: shipped checkpoint payload seq {got_seq} != "
+                f"advertised {seq}"
+            )
+        # re-base: fresh segment at seq+1; every older file is covered
+        self.journal.seq = seq
+        self.journal.durable_seq = seq
+        self.journal.roll()
+        prune_checkpoints(self.dcfg.state_dir, seq)
+        self.ckpt_seq = seq
+        self.recovered_from_checkpoint = True
+        if self._c_ckpts is not None:
+            self._c_ckpts.inc()
+            self._g_ckpt.set(seq)
+            self._g_durable.set(seq)
+        self.note_applied_seq(seq)
+        return state
+
     def should_checkpoint(self) -> bool:
         return (
             self.journal.seq - self.ckpt_seq
@@ -588,6 +639,7 @@ class DurabilityManager:
             "applied_seq": self.applied_seq,
             "last_checkpoint_seq": self.ckpt_seq,
             "recovery_replayed_records": self.replayed,
+            "journal_epoch": self.journal.epoch,
         }
 
     def close(self) -> None:

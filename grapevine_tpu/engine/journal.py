@@ -45,9 +45,11 @@ replay wherever they survive.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import struct
+import time
 from typing import Iterator, NamedTuple
 
 import numpy as np
@@ -77,6 +79,98 @@ _ROUND_COLS = (
 
 class JournalError(RuntimeError):
     """Journal corruption that replay must not paper over."""
+
+
+# -- epoch fencing (engine/replication.py promote(); OPERATIONS.md §23) --
+#
+# A promoting standby plants a ``fenced`` marker in the old primary's
+# state dir carrying the bumped journal epoch. The marker is created
+# O_EXCL, so a double-promote race has exactly one winner; a revived (or
+# still-running) stale primary refuses to append the moment it sees an
+# epoch newer than its own — the split-brain guard. The promoted
+# replica's own dir records its epoch in an ``epoch`` file instead, so
+# a later failover chain keeps monotonic generations.
+
+FENCE_FILE = "fenced"
+EPOCH_FILE = "epoch"
+
+
+def fence_path(state_dir: str) -> str:
+    return os.path.join(state_dir, FENCE_FILE)
+
+
+def read_fence(state_dir: str) -> dict | None:
+    """The fence marker's payload, or None when the dir is unfenced."""
+    try:
+        with open(fence_path(state_dir), "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        # an unreadable fence still fences: fail closed, loudly
+        raise JournalError(f"unreadable fence marker: {exc}") from exc
+
+
+def read_epoch(state_dir: str) -> int:
+    """This state dir's journal epoch (0 = never promoted into)."""
+    try:
+        with open(os.path.join(state_dir, EPOCH_FILE), encoding="utf-8") as fh:
+            return int(fh.read().strip() or 0)
+    except FileNotFoundError:
+        return 0
+
+
+def write_epoch(state_dir: str, epoch: int) -> None:
+    """Durably record this dir's journal epoch (promote() on the
+    replica's own dir)."""
+    from .checkpoint import write_all
+
+    path = os.path.join(state_dir, EPOCH_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    try:
+        write_all(fd, str(int(epoch)).encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    dfd = os.open(state_dir, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def write_fence(state_dir: str, epoch: int, fingerprint: str) -> dict:
+    """Fence a (presumed dead) primary's state dir at ``epoch``.
+
+    O_EXCL: in a double-promote race exactly one caller returns; the
+    loser gets a hard JournalError and must not serve."""
+    from .checkpoint import write_all
+
+    payload = {"epoch": int(epoch), "fingerprint": fingerprint,
+               "fenced_unix": int(time.time())}
+    path = fence_path(state_dir)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    except FileExistsError:
+        existing = read_fence(state_dir)
+        raise JournalError(
+            f"journal already fenced at epoch "
+            f"{existing.get('epoch') if existing else '?'} — another "
+            "replica won the promotion race; this one must not serve"
+        ) from None
+    try:
+        write_all(fd, json.dumps(payload).encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    dfd = os.open(state_dir, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return payload
 
 
 class JournalRecord(NamedTuple):
@@ -121,6 +215,17 @@ class BatchJournal:
         self._tail: tuple[str, int] | None = None  # (path, valid_end)
         self._cur_path: str | None = None  # segment open for append
         self._scanned = False
+        #: journal generation this writer serves under (epoch file in
+        #: the state dir, bumped by a promoting standby). An append is
+        #: refused the moment a fence marker with a newer epoch appears
+        #: — the split-brain guard (engine/replication.py promote()).
+        self.epoch = read_epoch(state_dir)
+        #: replication doorbell: ``on_append(seq, frame_bytes)`` called
+        #: after each frame lands in the file (page-cache durable — the
+        #: same durability a SIGKILL leaves behind). Runs under the
+        #: engine lock with the append, so it must only enqueue/signal,
+        #: never block on I/O (engine/replication.py JournalShipper).
+        self.on_append = None
         #: the only two legal blob lengths for this geometry (round
         #: bodies are constant-size given B; sweeps are fixed). Replay
         #: uses this to tell a corrupted length field (raise) from a
@@ -320,24 +425,186 @@ class BatchJournal:
                     self._tail = (path, off)
         self.durable_seq = self.seq
 
+    def _read_segment(self, path: str) -> bytes:
+        """Follower-path segment read with bounded-backoff retry on
+        transient errors (EIO from a flaky mount and friends). A
+        vanished file propagates FileNotFoundError — the scan loop
+        rescans the directory, because a roll/prune racing the reader
+        is normal, not an error."""
+        delay = 0.01
+        for attempt in range(4):
+            try:
+                with open(path, "rb") as fh:
+                    return fh.read()
+            except FileNotFoundError:
+                raise
+            except OSError as exc:
+                if attempt == 3:
+                    raise JournalError(
+                        f"{path}: transient read errors exhausted: {exc}"
+                    ) from exc
+                time.sleep(delay)
+                delay *= 2
+        raise AssertionError("unreachable")
+
+    def _follow_scan(self, after_seq: int):
+        """Hardened live-tail scan shared by :meth:`follow` and
+        :meth:`follow_frames`: yield ``(seq, body, frame_bytes)`` for
+        every frame with seq > ``after_seq``, oldest first, stopping
+        silently at the physical tail.
+
+        Liveness contract (ISSUE 19):
+
+        - a torn/incomplete FINAL frame at the physical end of the
+          final segment means "not yet durable — poll again", never an
+          error (the writer is mid-append, or died mid-append; either
+          way the bytes may still arrive or be truncated at the
+          writer's next open);
+        - a segment roll or checkpoint-prune racing the reader triggers
+          a directory rescan — segments the reader already consumed may
+          vanish freely; only genuinely missing data (the reader fell
+          behind the prune horizon) raises;
+        - transient read errors retry with bounded backoff before
+          raising (:meth:`_read_segment`).
+
+        Mid-file anomalies are still corruption and raise exactly like
+        :meth:`replay`."""
+        from .checkpoint import SealError, unseal
+
+        rescans = 0
+        while True:
+            try:
+                segments = self._segments()
+            except OSError as exc:
+                rescans += 1
+                if rescans > 8:
+                    raise JournalError(
+                        f"{self.state_dir}: directory scan errors "
+                        f"exhausted: {exc}"
+                    ) from exc
+                time.sleep(0.01 * rescans)
+                continue
+            # drop segments the reader has fully consumed: segment i is
+            # fully covered when its successor starts at or below
+            # after_seq + 1 (so a prune deleting it cannot matter)
+            while len(segments) > 1 and segments[1][0] <= after_seq + 1:
+                segments.pop(0)
+            if segments and segments[0][0] > after_seq + 1:
+                raise JournalError(
+                    f"follower at seq {after_seq} fell behind the prune "
+                    f"horizon — the earliest live segment starts at "
+                    f"{segments[0][0]}; re-bootstrap from a checkpoint"
+                )
+            try:
+                for si, (_, path) in enumerate(segments):
+                    last_seg = si == len(segments) - 1
+                    data = self._read_segment(path)
+                    off = 0
+                    while off < len(data):
+                        anomaly, mid_file = None, False
+                        body, end, seq = b"", off, -1
+                        if off + _HEADER.size > len(data):
+                            anomaly = "partial frame header"
+                            mid_file = not FRAME_MAGIC.startswith(
+                                data[off : off + len(FRAME_MAGIC)]
+                            )
+                        else:
+                            magic, seq, blob_len = _HEADER.unpack_from(
+                                data, off
+                            )
+                            if magic != FRAME_MAGIC:
+                                anomaly = "bad frame magic"
+                                mid_file = True
+                            elif blob_len not in self._valid_blob_lens:
+                                anomaly = (
+                                    f"frame {seq}: impossible blob "
+                                    f"length {blob_len}"
+                                )
+                                mid_file = True
+                            else:
+                                end = off + _HEADER.size + blob_len
+                                if end > len(data):
+                                    anomaly = f"frame {seq} cut short"
+                                else:
+                                    header = data[off : off + _HEADER.size]
+                                    try:
+                                        body = unseal(
+                                            self.root_key, b"journal",
+                                            data[off + _HEADER.size : end],
+                                            aad=header,
+                                        )
+                                    except SealError as exc:
+                                        anomaly = (
+                                            f"frame {seq} failed its "
+                                            f"integrity check: {exc}"
+                                        )
+                                        mid_file = end < len(data)
+                        if anomaly is not None:
+                            if last_seg and not mid_file:
+                                # physical tail not yet durable: poll
+                                # again on the next call — never an
+                                # error, never a warning per poll
+                                log.debug(
+                                    "follow: tail not yet durable "
+                                    "(%s@%d: %s)", path, off, anomaly,
+                                )
+                                return
+                            raise JournalError(f"{path}@{off}: {anomaly}")
+                        if seq > after_seq:
+                            if seq != after_seq + 1:
+                                raise JournalError(
+                                    f"{path}@{off}: sequence gap (frame "
+                                    f"{seq}, expected {after_seq + 1})"
+                                )
+                            yield seq, body, data[off:end]
+                            after_seq = seq
+                            rescans = 0
+                        off = end
+                return
+            except FileNotFoundError:
+                # roll/prune raced the reader between listdir and open —
+                # rescan; data that is genuinely gone trips the prune-
+                # horizon check above on the next pass
+                rescans += 1
+                if rescans > 8:
+                    raise JournalError(
+                        f"{self.state_dir}: segments kept vanishing "
+                        "mid-scan across 8 rescans"
+                    ) from None
+                continue
+
     def follow(self, after_seq: int = 0) -> Iterator[JournalRecord]:
-        """Read-only replication tail: yield records with seq >
-        ``after_seq`` exactly like :meth:`replay`, but for a follower
-        that will never append — point a second BatchJournal at a
-        shipped copy of the primary's state dir and apply records to
-        standby state, reporting progress via
+        """Read-only replication tail: yield decoded records with seq >
+        ``after_seq`` for a follower that will never append — apply
+        them to standby state and report progress via
         ``DurabilityManager.note_applied_seq`` (the
         ``grapevine_journal_applied_seq`` gauge the fleet aggregator
-        turns into replication lag; ROADMAP item 4, OPERATIONS.md §20).
-        Each call rescans the directory, so repeated calls pick up
-        newly shipped segments; a torn final frame is skipped this call
-        and retried on the next."""
+        turns into replication lag; OPERATIONS.md §20/§23). Each call
+        rescans the directory, so repeated calls pick up newly written
+        frames and freshly rolled segments; a torn final frame is
+        skipped this call and retried on the next (see
+        :meth:`_follow_scan` for the full liveness contract)."""
         if self._fd is not None:
             raise RuntimeError(
                 "follow() is for read-only followers; this journal is "
                 "open for append"
             )
-        yield from self.replay(after_seq=after_seq)
+        for seq, body, _frame in self._follow_scan(after_seq):
+            yield self._decode_body(seq, body)
+
+    def follow_frames(self, after_seq: int = 0) -> Iterator[tuple[int, bytes]]:
+        """Raw shipping tail: ``(seq, frame_bytes)`` with seq >
+        ``after_seq``, integrity-verified but not decoded — the
+        JournalShipper streams these bytes verbatim and the standby
+        re-journals them as-is (engine/replication.py). Same liveness
+        contract as :meth:`follow`."""
+        if self._fd is not None:
+            raise RuntimeError(
+                "follow_frames() is for read-only followers; this "
+                "journal is open for append"
+            )
+        for seq, _body, frame in self._follow_scan(after_seq):
+            yield seq, frame
 
     # -- append ---------------------------------------------------------
 
@@ -349,6 +616,9 @@ class BatchJournal:
             raise RuntimeError("replay() must run before open_for_append()")
         if self._fd is not None:
             return
+        # a revived stale primary must refuse HERE, before it truncates
+        # the tail a promoted replica already drained (split-brain guard)
+        self._check_fence()
         if self._tail is not None:
             path, valid_end = self._tail
             self._fd = os.open(path, os.O_WRONLY)
@@ -372,11 +642,24 @@ class BatchJournal:
         finally:
             os.close(dfd)
 
+    def _check_fence(self) -> None:
+        """Refuse to write under a newer epoch's fence (one stat per
+        append — noise next to the seal + write it guards)."""
+        fence = read_fence(self.state_dir)
+        if fence is not None and int(fence.get("epoch", 0)) > self.epoch:
+            raise JournalError(
+                f"journal fenced: epoch {fence['epoch']} supersedes this "
+                f"writer's epoch {self.epoch} — a standby promoted and "
+                "owns the sequence now; refusing append (split-brain "
+                "guard, OPERATIONS.md §23)"
+            )
+
     def _append(self, body: bytes) -> int:
         from .checkpoint import seal, write_all
 
         if self._fd is None:
             raise RuntimeError("journal not open for append")
+        self._check_fence()
         seq = self.seq + 1
         blob_len = len(body) + _SEAL_OVERHEAD
         header = _HEADER.pack(FRAME_MAGIC, seq, blob_len)
@@ -391,6 +674,11 @@ class BatchJournal:
         if faults.active():
             faults.crash("journal.append.post_write")
         self.seq = seq
+        if self.on_append is not None:
+            # replication doorbell: frame bytes are page-cache durable
+            # (what a SIGKILL leaves behind), so shipping pre-fsync
+            # keeps the standby at most the fsync batch behind
+            self.on_append(seq, frame)
         self._since_fsync += 1
         if self._since_fsync >= self.fsync_every:
             self.sync()
@@ -411,6 +699,42 @@ class BatchJournal:
         deterministic function of the state, so the record only fixes
         its position in the replay order."""
         return self._append(struct.pack("<B", KIND_FLUSH))
+
+    def append_raw(self, seq: int, frame: bytes) -> int:
+        """Follower-side append of a shipped frame verbatim (the bytes
+        the primary wrote, seal and all — the standby verified the seal
+        when it decoded the frame for apply). Contiguity and header
+        consistency are enforced here so a shipping bug can never write
+        a gap or a mislabeled frame the next recovery would refuse."""
+        from .checkpoint import write_all
+
+        if self._fd is None:
+            raise RuntimeError("journal not open for append")
+        self._check_fence()
+        if seq != self.seq + 1:
+            raise JournalError(
+                f"raw append out of order: frame {seq}, journal at "
+                f"{self.seq}"
+            )
+        if len(frame) < _HEADER.size:
+            raise JournalError(f"raw append: frame {seq} shorter than a header")
+        magic, hseq, blob_len = _HEADER.unpack_from(frame, 0)
+        if (
+            magic != FRAME_MAGIC
+            or hseq != seq
+            or blob_len not in self._valid_blob_lens
+            or len(frame) != _HEADER.size + blob_len
+        ):
+            raise JournalError(
+                f"raw append: malformed frame for seq {seq} "
+                f"(header seq {hseq}, {len(frame)} bytes)"
+            )
+        write_all(self._fd, frame)
+        self.seq = seq
+        self._since_fsync += 1
+        if self._since_fsync >= self.fsync_every:
+            self.sync()
+        return seq
 
     def sync(self) -> None:
         """fsync pending appends (the durability barrier)."""

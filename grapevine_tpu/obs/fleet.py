@@ -247,6 +247,17 @@ class FleetAggregator:
             "grapevine_fleet_journal_lag_seconds",
             "seconds the shard has spent behind the fleet's newest "
             "durable seq (0 while caught up)", labels=labels)
+        self._g_standbys = self.registry.gauge(
+            "grapevine_fleet_standbys",
+            "members whose /healthz reports role=standby — live hot "
+            "replicas replaying the shipped journal (a promoted "
+            "standby leaves this count and starts serving; "
+            "OPERATIONS.md §23)")
+        self._g_promotions = self.registry.gauge(
+            "grapevine_fleet_promotions",
+            "sum of members' promotion counters — a nonzero value "
+            "means a takeover happened and the fenced old primary "
+            "needs operator attention (OPERATIONS.md §23 runbook)")
         self.uniformity = (
             FleetUniformityMonitor(
                 self.n, cfg.uniformity, registry=self.registry)
@@ -334,6 +345,7 @@ class FleetAggregator:
                 else -1.0,
                 shard=str(i))
         self._update_lag(now)
+        self._update_standbys()
         if self.uniformity is not None:
             self.uniformity.observe_tick(samples)
             self.uniformity.verdict()  # refresh the exported gauges
@@ -392,6 +404,28 @@ class FleetAggregator:
                 st.t_caught_up = st.t_caught_up or base
                 self._g_lag_sec.set(round(now - base, 3), shard=str(i))
 
+    def _update_standbys(self) -> None:
+        """Count live standbys and sum promotion counters across the
+        fleet. Role comes from /healthz (the body tag every member
+        carries) — an un-promoted standby exports no round counter, so
+        nothing else in the merge distinguishes it from a dead shard."""
+        standbys = 0
+        promotions = 0.0
+        with self._lock:
+            for st in self._members:
+                hz = st.healthz or {}
+                if st.up and hz.get("role") == "standby" \
+                        and not hz.get("promoted"):
+                    standbys += 1
+                p = _sample_value(
+                    st.families or {},
+                    "grapevine_replication_promotions_total",
+                    default=None)
+                if p is not None:
+                    promotions += p
+        self._g_standbys.set(float(standbys))
+        self._g_promotions.set(promotions)
+
     # -- merged views ---------------------------------------------------
 
     def render_merged(self) -> str:
@@ -448,13 +482,23 @@ class FleetAggregator:
             for i, st in enumerate(self._members):
                 hz = st.healthz or {}
                 m_healthy = hz.get("healthy")
-                members.append({
+                entry = {
                     "shard": i,
                     "address": self.cfg.members[i],
                     "up": bool(st.up),
                     "healthy": m_healthy,
                     "leakaudit": hz.get("leakaudit"),
-                })
+                }
+                if hz.get("role") is not None:
+                    entry["role"] = hz["role"]
+                if hz.get("role") == "standby":
+                    # the DR surface an operator pages on: is the
+                    # replica fed, and at what epoch (OPERATIONS.md §23)
+                    entry["promoted"] = bool(hz.get("promoted"))
+                    entry["replication_connected"] = bool(
+                        hz.get("replication_connected"))
+                    entry["journal_epoch"] = hz.get("journal_epoch")
+                members.append(entry)
                 healthy = healthy and st.up and bool(m_healthy)
                 slo = hz.get("slo") or {}
                 worst_fast = max(worst_fast,
@@ -464,6 +508,9 @@ class FleetAggregator:
         detail: dict = {
             "role": "fleet",
             "n_members": self.n,
+            "n_standbys": sum(
+                1 for m in members
+                if m.get("role") == "standby" and not m.get("promoted")),
             "members": members,
             # merged burn rates: the fleet burns as fast as its
             # worst-burning shard (error budgets do not average away)

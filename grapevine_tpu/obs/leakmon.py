@@ -446,6 +446,14 @@ class EngineLeakMonitor:
         self._seq = 0
         self._suspect = False
         self._last_verdict: dict | None = None
+        #: replication cadence books (engine/replication.py): when a
+        #: JournalShipper is attached, its byte-cadence stats join the
+        #: verdict schema as a ``ship_cadence`` detector — shipping
+        #: traffic must be a pure function of the round counter
+        #: (constant frame sizes, constant framing), so any
+        #: content-sized byte on the wire is a SUSPECT exactly like an
+        #: access-pattern detector tripping
+        self._shipper = None
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="grapevine-leakmon"
         )
@@ -487,12 +495,31 @@ class EngineLeakMonitor:
 
     # -- verdict views --------------------------------------------------
 
+    def attach_shipper(self, shipper) -> None:
+        """Fold a JournalShipper's cadence books into the verdict
+        schema (see the ``_shipper`` field note). Pass None to detach."""
+        self._shipper = shipper
+
     def verdict(self) -> dict:
         """Fresh verdict over the current windows (the /leakaudit body)."""
         v = self.monitor.verdict()
         v["rounds_observed"] = self._processed
         v["rounds_dropped"] = int(
             self._c_dropped.get()) if self._c_dropped else 0
+        if self._shipper is not None:
+            rep = self._shipper.stats()
+            v["replication"] = rep
+            v["detectors"].append({
+                "name": "ship_cadence",
+                "tree": "journal",
+                "statistic": float(rep["illegal_frames"]),
+                "threshold": 0.0,
+                "samples": int(rep["frames_shipped"]),
+                "min_samples": 1,
+                "verdict": PASS if rep["cadence_ok"] else SUSPECT,
+            })
+            if not rep["cadence_ok"]:
+                v["verdict"] = SUSPECT
         return v
 
     def last_verdict(self) -> dict:

@@ -156,7 +156,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--role",
-        choices=["mono", "engine", "frontend", "fleet"],
+        choices=["mono", "engine", "frontend", "fleet", "standby"],
         default="mono",
         help="mono = engine + sessions in one process (default); "
         "engine = device engine tier only (serves the internal Submit "
@@ -165,7 +165,11 @@ def build_parser() -> argparse.ArgumentParser:
         "behind a load balancer — server/tier.py); fleet = scrape "
         "aggregator over N member processes' metrics endpoints, "
         "serving merged shard-labeled /metrics, /healthz, /leakaudit "
-        "with cross-shard uniformity detectors (obs/fleet.py)",
+        "with cross-shard uniformity detectors (obs/fleet.py); "
+        "standby = hot replica replaying a primary's shipped journal "
+        "(engine/replication.py, OPERATIONS.md §23) — SIGUSR1 "
+        "promotes it and it starts serving the Submit API on "
+        "--engine-listen",
     )
     p.add_argument(
         "--fleet-members",
@@ -198,6 +202,41 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--engine",
         help="(role=frontend) host:port of the engine tier's Submit API",
+    )
+    p.add_argument(
+        "--replicate-to",
+        help="(mono/engine, with --state-dir) host:port of a standby "
+        "replica's --standby-listen endpoint: stream every sealed "
+        "journal frame there at round cadence (engine/replication.py). "
+        "Shipping traffic is a pure function of round count — the "
+        "frames are the sealed constant-size journal records, so the "
+        "leak monitor's cadence policing covers the wire verbatim "
+        "(OPERATIONS.md §23)",
+    )
+    p.add_argument(
+        "--ship-every",
+        type=int,
+        default=1,
+        help="(with --replicate-to) journal frames per shipping wake "
+        "(default 1 = every frame immediately). N>1 batches wakes; the "
+        "standby still receives every frame, just up to N-1 frames "
+        "later — a standby-RPO knob, not a durability knob",
+    )
+    p.add_argument(
+        "--standby-listen",
+        default="127.0.0.1:0",
+        help="(role=standby) host:port to accept the primary's "
+        "replication feed on (0 = ephemeral; keep it on localhost or "
+        "a private interface — frames are sealed, but the cadence is "
+        "operational telemetry)",
+    )
+    p.add_argument(
+        "--promote-from",
+        help="(role=standby) the primary's --state-dir path, reachable "
+        "at promotion time (shared volume): promote() plants the "
+        "split-brain fence there and drains the durable journal tail "
+        "for RPO 0. Omitted = promote from shipped state only "
+        "(accepting the shipping lag as RPO)",
     )
     p.add_argument(
         "--metrics-port",
@@ -377,18 +416,29 @@ _ENGINE_GEOM_FLAGS = {"posmap_impl", "tree_top_cache_levels",
 #: serve no engine
 _FLEET_FLAGS = {"fleet_members", "fleet_scrape_interval", "fleet_port"}
 
+#: journal shipping needs the journal in-process, so only roles that
+#: own a durable engine take --replicate-to — a frontend supplying it
+#: would silently replicate nothing (its journal lives in the engine
+#: tier), exactly the misconfiguration that must fail loudly before an
+#: operator believes they have a standby
+_REPLICATION_FLAGS = {"replicate_to", "ship_every"}
+
+#: the standby's own surface: its replication listener and the
+#: primary state dir it fences at promotion
+_STANDBY_FLAGS = {"standby_listen", "promote_from"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
              "metrics_port", "metrics_host"}
             | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
-            | _ENGINE_GEOM_FLAGS,
+            | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
                "seed", "verbose", "role", "metrics_port", "metrics_host"}
               | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
-              | _ENGINE_GEOM_FLAGS,
+              | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
                  "metrics_port", "metrics_host"},
@@ -396,6 +446,17 @@ _ROLE_FLAGS = {
     # scrapes declared members and serves the merged view — the only
     # non-fleet flag it takes is the bind interface
     "fleet": {"role", "verbose", "metrics_host"} | _FLEET_FLAGS,
+    # the standby owns a durable device engine (it replays into one)
+    # and, after promotion, serves the internal Submit API — so it
+    # takes geometry + durability + the engine tier's listener, but no
+    # client-facing session flags and no --replicate-to (it is the
+    # replication *target*; chaining standbys is not supported)
+    "standby": {"role", "verbose", "seed", "expiry_period",
+                "msg_capacity", "recipient_capacity", "batch_size",
+                "batch_wait_ms", "engine_listen", "metrics_port",
+                "metrics_host"}
+               | _STANDBY_FLAGS | _DURABILITY_FLAGS | _LEAKMON_FLAGS
+               | _TRACE_SLO_FLAGS | _ENGINE_GEOM_FLAGS,
 }
 
 
@@ -547,6 +608,63 @@ def main(argv=None) -> int:
             agg.stop()
         return 0
 
+    if args.role == "standby":
+        import signal
+        import threading
+
+        from ..engine.replication import StandbyReplica
+
+        dcfg = _durability_config(args)
+        if dcfg is None:
+            raise SystemExit(
+                "--role standby requires --state-dir (the replica "
+                "appends shipped frames to its own sealed journal)"
+            )
+        replica = StandbyReplica(config, seed=args.seed, durability=dcfg)
+        host, _, port_s = args.standby_listen.rpartition(":")
+        sport = replica.listen(host or "127.0.0.1", int(port_s or 0))
+        print(f"grapevine-tpu standby replica on port {sport}",
+              flush=True)
+        if args.metrics_port is not None:
+            mport = replica.start_metrics(args.metrics_port,
+                                          host=args.metrics_host)
+            print(f"metrics endpoint on port {mport}", flush=True)
+        # SIGUSR1 = the operator's (or orchestrator's) promotion order;
+        # the handler only sets an event — the takeover itself (fence,
+        # tail drain, flush completion) runs on the main thread
+        promote_wake = threading.Event()
+        signal.signal(signal.SIGUSR1, lambda s, f: promote_wake.set())
+        _install_drain_handlers(replica.close)
+        try:
+            promote_wake.wait()
+        except KeyboardInterrupt:  # pragma: no cover - handler owns it
+            replica.close()
+            return 0
+        info = replica.promote(primary_state_dir=args.promote_from)
+        print(
+            f"standby promoted: epoch {info['epoch']}, drained "
+            f"{info['drained_frames']} durable frames, "
+            f"rto {info['rto_seconds']:.3f}s", flush=True,
+        )
+        from .tier import EngineServer
+
+        server = EngineServer(
+            engine=replica.engine, max_wait_ms=args.batch_wait_ms,
+            leakmon=_leakmon_config(args),
+            worker_restart=args.worker_restart,
+            trace_ring_size=args.trace_ring_size, slo=_slo_config(args),
+            profile_enable=args.profile_enable,
+        )
+        eport = server.start(args.engine_listen)
+        print(f"promoted engine tier listening on port {eport}",
+              flush=True)
+        _install_drain_handlers(lambda: server.stop(checkpoint=True))
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:  # pragma: no cover - handler owns it
+            server.stop(checkpoint=True)
+        return 0
+
     if args.role == "engine":
         import threading
 
@@ -559,7 +677,9 @@ def main(argv=None) -> int:
                               worker_restart=args.worker_restart,
                               trace_ring_size=args.trace_ring_size,
                               slo=_slo_config(args),
-                              profile_enable=args.profile_enable)
+                              profile_enable=args.profile_enable,
+                              replicate_to=args.replicate_to,
+                              ship_every=args.ship_every)
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
@@ -598,6 +718,8 @@ def main(argv=None) -> int:
             trace_ring_size=args.trace_ring_size,
             slo=_slo_config(args),
             profile_enable=args.profile_enable,
+            replicate_to=args.replicate_to,
+            ship_every=args.ship_every,
         )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None

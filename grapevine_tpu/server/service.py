@@ -99,8 +99,15 @@ class GrapevineServer:
         trace_ring_size: int = 512,
         slo=None,
         profile_enable: bool = False,
+        replicate_to: str | None = None,
+        ship_every: int = 1,
     ):
         self.config = config or GrapevineConfig()
+        if scheduler is not None and replicate_to is not None:
+            raise ValueError(
+                "replication needs the journal in-process (the frontend "
+                "role has no journal to ship)"
+            )
         if scheduler is not None:
             # injected op sink (server/tier.py's FrontendServer passes
             # its engine-tier RPC stub): no in-process device engine
@@ -169,6 +176,21 @@ class GrapevineServer:
 
             self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
             self.engine.attach_leakmon(self.leakmon)
+        #: primary-side journal shipping (engine/replication.py): stream
+        #: every sealed frame to a hot standby. Device-owner only — the
+        #: frontend role has no journal.
+        self.shipper = None
+        if replicate_to is not None:
+            from ..engine.replication import JournalShipper
+
+            self.shipper = JournalShipper(
+                self.engine, replicate_to, ship_every=ship_every
+            )
+            self.shipper.start()
+            if self.leakmon is not None:
+                # fold the shipper's frame-length books into the audit
+                # verdict (ship_cadence detector, obs/leakmon.py)
+                self.leakmon.attach_shipper(self.shipper)
         #: round-trace profiler + commit-latency SLO + optional capture
         #: gate — one shared attach policy (obs.attach_round_observability
         #: has the rationale and the observe-only default contract)
@@ -378,6 +400,11 @@ class GrapevineServer:
                 # last-durable-round + recovery progress (batch-level
                 # sequence numbers only) — the RPO a probe can alert on
                 detail["durability"] = self.engine.durability.status()
+        if self.shipper is not None:
+            detail["replication"] = self.shipper.stats()
+            # a fatally-fenced shipper means a standby promoted out from
+            # under us — this primary must stop serving (split-brain)
+            healthy = healthy and self.shipper.fatal is None
         if self.leakmon is not None:
             # the leak audit verdict is part of liveness: a SUSPECT
             # transcript means the engine is *misbehaving* even though
@@ -445,6 +472,8 @@ class GrapevineServer:
             self._metrics_server = None
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
+        if self.shipper is not None:
+            self.shipper.close()
         self.scheduler.close()
         if self.leakmon is not None:
             self.leakmon.close()

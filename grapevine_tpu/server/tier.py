@@ -66,18 +66,33 @@ class EngineServer:
                  max_wait_ms: float | None = None, clock=None, leakmon=None,
                  durability=None, worker_restart: bool = False,
                  trace_ring_size: int = 512, slo=None,
-                 profile_enable: bool = False):
+                 profile_enable: bool = False, engine=None,
+                 replicate_to: str | None = None, ship_every: int = 1):
         from ..engine.batcher import GrapevineEngine
         from ..session import get_signature_scheme
         from .scheduler import BatchScheduler
 
         import time as _time
 
-        self.config = config or GrapevineConfig()
-        # durable construction runs recovery before the listener binds
-        self.engine = GrapevineEngine(
+        self.config = (engine.config if engine is not None
+                       else config or GrapevineConfig())
+        # durable construction runs recovery before the listener binds;
+        # ``engine`` injection lets a promoted StandbyReplica serve its
+        # already-warm state in-process — no second recovery, so the
+        # "serving inside one checkpoint interval" RTO claim holds
+        self.engine = engine or GrapevineEngine(
             self.config, seed=seed, durability=durability
         )
+        #: primary-side journal shipping (engine/replication.py) — the
+        #: engine tier owns the journal, so it owns the feed
+        self.shipper = None
+        if replicate_to is not None:
+            from ..engine.replication import JournalShipper
+
+            self.shipper = JournalShipper(
+                self.engine, replicate_to, ship_every=ship_every
+            )
+            self.shipper.start()
         #: continuous obliviousness auditing (obs/leakmon.py) — the
         #: engine tier owns the device, so it owns the transcript audit
         self.leakmon = None
@@ -86,6 +101,10 @@ class EngineServer:
 
             self.leakmon = EngineLeakMonitor.for_engine(self.engine, leakmon)
             self.engine.attach_leakmon(self.leakmon)
+            if self.shipper is not None:
+                # ship-cadence detector: the audit verdict folds the
+                # shipper's frame-length books (leakmon.py rationale)
+                self.leakmon.attach_shipper(self.shipper)
         #: round tracing + commit-latency SLO + optional capture gate —
         #: one shared attach policy (obs.attach_round_observability has
         #: the rationale and the observe-only default contract)
@@ -195,6 +214,11 @@ class EngineServer:
         }
         if self.engine.durability is not None:
             detail["durability"] = self.engine.durability.status()
+        if self.shipper is not None:
+            detail["replication"] = self.shipper.stats()
+            # a fatally-fenced shipper means a standby promoted out from
+            # under us — this primary must stop serving (split-brain)
+            healthy = healthy and self.shipper.fatal is None
         if self.leakmon is not None:
             # same folding as the monolithic server: a SUSPECT transcript
             # is a serving fault — 503 stops routing (cached verdict; the
@@ -249,6 +273,8 @@ class EngineServer:
             self._metrics_server = None
         if self._grpc_server is not None:
             self._grpc_server.stop(grace).wait()
+        if self.shipper is not None:
+            self.shipper.close()
         self.scheduler.close()
         if self.leakmon is not None:
             self.leakmon.close()

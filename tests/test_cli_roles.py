@@ -74,6 +74,33 @@ def _check(argv):
     ["--role", "fleet", "--fleet-members", "h0:1",
      "--metrics-port", "9464"],
     ["--role", "fleet", "--fleet-members", "h0:1", "--seed", "0"],
+    # journal shipping needs the journal in-process (ISSUE 19): a
+    # frontend supplying --replicate-to would silently replicate
+    # nothing (its journal lives in the engine tier) — rejected even
+    # at the --ship-every default; the fleet owns no journal either
+    ["--role", "frontend", "--replicate-to", "127.0.0.1:4100"],
+    ["--role", "frontend", "--ship-every", "1"],
+    ["--role", "fleet", "--fleet-members", "h0:1",
+     "--replicate-to", "127.0.0.1:4100"],
+    # ...and the standby's own surface belongs to the standby role
+    # alone: any other role supplying --standby-listen or
+    # --promote-from would silently stand nothing by — rejected even
+    # at default values
+    ["--role", "mono", "--standby-listen", "127.0.0.1:0"],
+    ["--role", "engine", "--standby-listen", "127.0.0.1:0"],
+    ["--role", "frontend", "--standby-listen", "127.0.0.1:0"],
+    ["--role", "mono", "--promote-from", "/var/lib/grapevine"],
+    ["--role", "engine", "--promote-from", "/var/lib/grapevine"],
+    # the standby is the replication TARGET: it takes no client-facing
+    # listener, no --replicate-to chain, no fleet topology
+    ["--role", "standby", "--state-dir", "/x",
+     "--replicate-to", "127.0.0.1:4100"],
+    ["--role", "standby", "--state-dir", "/x", "--listen",
+     "insecure-grapevine://0.0.0.0:3229"],
+    ["--role", "standby", "--state-dir", "/x", "--identity-seed",
+     "ab" * 32],
+    ["--role", "standby", "--state-dir", "/x",
+     "--fleet-members", "h0:1"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -131,6 +158,24 @@ def test_misapplied_flags_rejected(argv):
      "--fleet-scrape-interval", "0.25", "--fleet-port", "0"],
     ["--role", "fleet", "--fleet-members", "h0:1",
      "--metrics-host", "127.0.0.1", "-v"],
+    # device-owning roles ship their journal to a standby (ISSUE 19),
+    # alone and with the shipping cadence knob
+    ["--role", "mono", "--state-dir", "/x",
+     "--replicate-to", "127.0.0.1:4100"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--state-dir", "/x", "--replicate-to", "127.0.0.1:4100",
+     "--ship-every", "4"],
+    # the standby role: its feed listener, the primary dir it fences
+    # at promotion, durability + geometry (it replays into a real
+    # engine), and the engine listener it serves on after promotion
+    ["--role", "standby", "--state-dir", "/x"],
+    ["--role", "standby", "--state-dir", "/x",
+     "--standby-listen", "127.0.0.1:0",
+     "--promote-from", "/var/lib/grapevine",
+     "--engine-listen", "127.0.0.1:0"],
+    ["--role", "standby", "--state-dir", "/x", "--evict-every", "4",
+     "--pipeline-depth", "1", "--tree-top-cache-levels", "0",
+     "--metrics-port", "0"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

@@ -209,6 +209,63 @@ def test_healthz_folds_members_burn_rates_and_uniformity():
     assert not healthy
 
 
+def test_standby_fold_counts_roles_and_sums_promotions():
+    """_update_standbys (ISSUE 19): ``grapevine_fleet_standbys`` counts
+    live un-promoted role=standby members by their /healthz tag (a fed
+    standby exports no round counter, so nothing else in the merge
+    distinguishes it from a dead shard), ``grapevine_fleet_promotions``
+    sums the members' promotion counters, and the fleet /healthz entry
+    carries the DR surface an operator pages on."""
+    agg, fake, t = _fresh_agg()
+    fake.members["m0:1"] = {
+        "/metrics": member_text(4),
+        "/healthz": {"healthy": True, "role": "engine"},
+    }
+    standby_metrics = (
+        "# TYPE grapevine_replication_promotions_total counter\n"
+        "grapevine_replication_promotions_total 0\n")
+    fake.members["m1:1"] = {
+        "/metrics": standby_metrics,
+        "/healthz": {"healthy": True, "role": "standby",
+                     "promoted": False, "replication_connected": True,
+                     "journal_epoch": 0},
+    }
+    agg.scrape_once()
+
+    def fleet_gauge(name):
+        fams = parse_exposition(agg.render_merged())
+        ((_, _, val),) = fams[name]["samples"]
+        return val
+
+    assert fleet_gauge("grapevine_fleet_standbys") == 1.0
+    assert fleet_gauge("grapevine_fleet_promotions") == 0.0
+    healthy, detail = agg.healthz()
+    assert healthy and detail["n_standbys"] == 1
+    (sb,) = [m for m in detail["members"] if m.get("role") == "standby"]
+    assert sb["promoted"] is False
+    assert sb["replication_connected"] is True
+    assert sb["journal_epoch"] == 0
+    # the DR keys are the standby's surface alone
+    (eng,) = [m for m in detail["members"] if m.get("role") == "engine"]
+    assert "promoted" not in eng and "replication_connected" not in eng
+
+    # promotion flips the member out of the standby count and into the
+    # promotions sum — the fleet sees the takeover, not a dead shard
+    fake.members["m1:1"]["/metrics"] = standby_metrics.replace(
+        "total 0", "total 1")
+    fake.members["m1:1"]["/healthz"] = {
+        "healthy": True, "role": "standby", "promoted": True,
+        "replication_connected": False, "journal_epoch": 1}
+    t[0] += 2.0
+    agg.scrape_once()
+    assert fleet_gauge("grapevine_fleet_standbys") == 0.0
+    assert fleet_gauge("grapevine_fleet_promotions") == 1.0
+    _, detail = agg.healthz()
+    assert detail["n_standbys"] == 0
+    (sb,) = [m for m in detail["members"] if m.get("role") == "standby"]
+    assert sb["promoted"] is True and sb["journal_epoch"] == 1
+
+
 def test_leakaudit_folds_member_verdicts():
     agg, fake, t = _fresh_agg()
     fake.members["m0:1"] = {"/metrics": member_text(4),

@@ -22,6 +22,9 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_run.py --trials 50
     JAX_PLATFORMS=cpu python tools/chaos_run.py --points   # one trial
                                                            # per fault site
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --standby --points
+        # hot-standby mode: SIGKILL the primary once at every fault
+        # site and verify the promoted replica instead of a restart
 
 The child re-enters this file with ``--child``; a shared JAX persistent
 compilation cache keeps relaunches from re-paying the compile.
@@ -201,10 +204,21 @@ def run_child(args) -> int:
                 args.pipeline_depth, args.evict_every, args.shards),
         seed=ENGINE_SEED, durability=dcfg,
     )
+    shipper = None
+    if args.replicate_to:
+        # hot-standby chaos (--standby): this child is the PRIMARY,
+        # streaming every sealed frame to the parent's replica until
+        # the armed fault SIGKILLs it mid-protocol
+        from grapevine_tpu.engine.replication import JournalShipper
+
+        shipper = JournalShipper(engine, args.replicate_to)
+        shipper.start()
     monitor = EngineLeakMonitor.for_engine(
         engine, LeakMonitorConfig(window_rounds=64)
     )
     engine.attach_leakmon(monitor)
+    if shipper is not None:
+        monitor.attach_shipper(shipper)
     # the PR-6 observability stack rides every chaos incarnation (as it
     # does in serving): tracing/SLO must never perturb recovery
     # bit-equality, and the tracer's schema check runs on real
@@ -230,6 +244,8 @@ def run_child(args) -> int:
         pf.write(f"leakmon {verdict}\n")
         pf.write(f"final {final}\n")
         pf.flush()
+    if shipper is not None:
+        shipper.close()
     engine.close()
     return 0
 
@@ -467,6 +483,203 @@ def run_trial(trial: int, mode: str, rng: random.Random, args,
     return errors
 
 
+def run_standby_trial(trial: int, mode: str, rng: random.Random, args,
+                      oracle_hashes, oracle_final,
+                      cache_dir: str) -> list[str]:
+    """One kill-the-primary takeover trial (--standby).
+
+    The parent process hosts a live :class:`StandbyReplica` (same
+    geometry as the oracle: serial, single-chip — so its jitted
+    programs are already warm from the oracle run, which is the hot
+    part of "hot standby"). The child is the PRIMARY: it runs the
+    schedule with ``--replicate-to`` pointed at the replica and is
+    SIGKILLed ONCE at the armed fault site — including mid-flush and
+    mid-fsync — with no restart. The parent then promotes the replica
+    (fencing the dead primary's state dir, draining its durable tail
+    off disk), drives the REMAINING schedule on the promoted engine,
+    and holds the whole run to the uninterrupted serial oracle:
+    per-round response hashes, final state bit-identity, leakmon PASS.
+    RPO 0 for durable frames and RTO = the measured promote() wall
+    time, printed per trial."""
+    errors: list[str] = []
+    if mode.startswith("flush.") and (args.evict_every or 1) <= 1:
+        print(
+            f"trial {trial:3d} [{mode:>26s}]: SKIP "
+            "(evict_every=1 — no flush sites; rerun with "
+            "--evict-every > 1 for kill-at-flush coverage)",
+            flush=True,
+        )
+        return errors
+    from grapevine_tpu.config import DurabilityConfig
+    from grapevine_tpu.engine.checkpoint import state_to_bytes
+    from grapevine_tpu.engine.journal import BatchJournal, JournalError
+    from grapevine_tpu.engine.replication import StandbyReplica
+    from grapevine_tpu.obs.leakmon import EngineLeakMonitor, LeakMonitorConfig
+
+    events = build_schedule(args.schedule_seed, args.events)
+    with tempfile.TemporaryDirectory(prefix=f"chaos{trial}-") as root:
+        primary_dir = os.path.join(root, "primary")
+        standby_dir = os.path.join(root, "standby")
+        os.makedirs(primary_dir)
+        os.makedirs(standby_dir)
+        progress = os.path.join(root, "progress.log")
+        # replication's standing requirement (engine/replication.py,
+        # OPERATIONS.md §23): primary and standby share the root seal
+        # key — a standby with its own key cannot unseal a single
+        # shipped frame. Provision one key into both dirs up front,
+        # exactly what a production secret mount does.
+        key = bytes(rng.randrange(256) for _ in range(32))
+        for d in (primary_dir, standby_dir):
+            kp = os.path.join(d, "root.key")
+            with open(kp, "wb") as fh:
+                fh.write(key)
+            os.chmod(kp, 0o600)
+        replica = StandbyReplica(
+            _config(args.posmap_impl, args.tree_top_cache_levels,
+                    pipeline_depth=1, evict_every=args.evict_every,
+                    shards=1),
+            seed=ENGINE_SEED,
+            durability=DurabilityConfig(
+                state_dir=standby_dir,
+                checkpoint_every_rounds=args.checkpoint_every,
+                journal_fsync_every=1,
+            ),
+        )
+        try:
+            port = replica.listen()
+            child_cmd = [
+                sys.executable, os.path.abspath(__file__), "--child",
+                "--state-dir", primary_dir, "--progress", progress,
+                "--events", str(args.events),
+                "--schedule-seed", str(args.schedule_seed),
+                "--checkpoint-every", str(args.checkpoint_every),
+                "--replicate-to", f"127.0.0.1:{port}",
+            ]
+            if args.posmap_impl:
+                child_cmd += ["--posmap-impl", args.posmap_impl]
+            if args.tree_top_cache_levels is not None:
+                child_cmd += ["--tree-top-cache-levels",
+                              str(args.tree_top_cache_levels)]
+            if args.pipeline_depth is not None:
+                child_cmd += ["--pipeline-depth", str(args.pipeline_depth)]
+            if args.evict_every is not None:
+                child_cmd += ["--evict-every", str(args.evict_every)]
+            if args.shards is not None:
+                child_cmd += ["--shards", str(args.shards)]
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+            )
+            env.pop("GRAPEVINE_FAULTS", None)
+            if (args.shards or 1) > 1:
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    env["XLA_FLAGS"] = (
+                        f"{flags} --xla_force_host_platform_device_count="
+                        f"{args.shards}"
+                    ).strip()
+            cache_fork = _fork_cache(cache_dir)
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_fork
+            timer_kill = None
+            if mode == "timer":
+                timer_kill = rng.uniform(1.0, args.timer_max_s)
+            else:
+                if mode.startswith("checkpoint."):
+                    cap = max(2, args.events // args.checkpoint_every)
+                elif mode.startswith("flush."):
+                    cap = max(2, args.events // max(1, args.evict_every or 1))
+                else:
+                    cap = max(2, args.events // 2)
+                env["GRAPEVINE_FAULTS"] = f"{mode}={rng.randrange(1, cap)}"
+            proc = subprocess.Popen(
+                child_cmd, env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            )
+            if timer_kill is not None:
+                try:
+                    proc.wait(timeout=timer_kill)
+                except subprocess.TimeoutExpired:
+                    proc.send_signal(signal.SIGKILL)
+            _, err = proc.communicate()
+            rc = proc.returncode
+            if rc == 0:
+                _merge_cache(cache_fork, cache_dir)
+            shutil.rmtree(cache_fork, ignore_errors=True)
+            if rc not in (0, -signal.SIGKILL):
+                errors.append(
+                    f"trial {trial} [standby:{mode}]: primary exited "
+                    f"rc={rc} (want clean or SIGKILL): "
+                    f"{err.decode()[-2000:]}"
+                )
+                return errors
+            killed = rc == -signal.SIGKILL
+            # fenced takeover: plant the epoch fence in the dead
+            # primary's dir, drain its durable tail, complete any
+            # pending flush — the measured RTO
+            info = replica.promote(primary_state_dir=primary_dir)
+            eng = replica.engine
+            monitor = EngineLeakMonitor.for_engine(
+                eng, LeakMonitorConfig(window_rounds=64)
+            )
+            eng.attach_leakmon(monitor)
+            start = _events_done(events, eng.durability.seq,
+                                 eng.evict_every)
+            with open(progress, "a") as pf:
+                _run_events(eng, events, start, pf)
+                monitor.close()
+                verdict = monitor.verdict()["verdict"]
+                final = hashlib.sha256(
+                    state_to_bytes(eng.ecfg, eng.state)
+                ).hexdigest()
+                pf.write(f"leakmon {verdict}\n")
+                pf.write(f"final {final}\n")
+                pf.flush()
+            # split-brain guard, live: a revived incarnation of the
+            # killed primary must be refused at journal-open time
+            try:
+                stale = BatchJournal(primary_dir, replica.dm.root_key,
+                                     replica.dm.ecfg)
+                for _rec in stale.replay():
+                    pass
+                stale.open_for_append()
+            except JournalError:
+                pass
+            else:
+                errors.append(
+                    f"trial {trial} [standby:{mode}]: revived stale "
+                    "primary was NOT refused by the epoch fence"
+                )
+        finally:
+            replica.close()
+        seq_hashes, finals, leakmons = _parse_progress(progress)
+        for seq, h in sorted(seq_hashes.items()):
+            if oracle_hashes.get(seq) != h:
+                errors.append(
+                    f"trial {trial} [standby:{mode}]: responses for "
+                    f"round {seq} diverge from the uninterrupted run"
+                )
+        if not finals or finals[-1] != oracle_final:
+            errors.append(
+                f"trial {trial} [standby:{mode}]: promoted final state "
+                "is not bit-identical to the uninterrupted run"
+            )
+        if not leakmons or leakmons[-1] != "PASS":
+            errors.append(
+                f"trial {trial} [standby:{mode}]: leak monitor verdict "
+                f"{leakmons[-1] if leakmons else 'missing'} (want PASS)"
+            )
+        if not errors:
+            print(
+                f"trial {trial:3d} [{mode:>26s}]: PASS "
+                f"({'killed' if killed else 'clean'}, promoted epoch "
+                f"{info['epoch']}, drained {info['drained_frames']} "
+                f"durable frames, rto {info['rto_seconds'] * 1e3:.0f}ms, "
+                f"{len(seq_hashes)}/{len(oracle_hashes)} rounds recorded)",
+                flush=True,
+            )
+    return errors
+
+
 def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
     """Run ``n_trials`` randomized trials (or one per entry of
     ``modes``); returns accumulated failures. Importable by the slow
@@ -491,10 +704,11 @@ def run_trials(n_trials: int, args=None, modes=None) -> list[str]:
             rng.choice(list(ALL_POINTS) + ["timer"]) for _ in range(n_trials)
         ]
     failures: list[str] = []
+    trial_fn = run_standby_trial if args.standby else run_trial
     for trial, mode in enumerate(modes):
         failures.extend(
-            run_trial(trial, mode, rng, args, oracle_hashes, oracle_final,
-                      cache_dir)
+            trial_fn(trial, mode, rng, args, oracle_hashes, oracle_final,
+                     cache_dir)
         )
     return failures
 
@@ -513,6 +727,19 @@ def parse_args(argv):
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--timer-max-s", type=float, default=12.0)
+    p.add_argument("--standby", action="store_true",
+                   help="hot-standby takeover trials instead of "
+                   "restart-in-place: the child primary ships its "
+                   "journal to an in-parent StandbyReplica "
+                   "(engine/replication.py) and is SIGKILLed ONCE at "
+                   "the armed site with no restart; the parent "
+                   "promotes (fenced), drives the remaining schedule "
+                   "on the promoted engine, and holds the whole run "
+                   "to the serial oracle bit-for-bit with leakmon "
+                   "PASS. Prints the measured RTO per trial")
+    p.add_argument("--replicate-to", default=None,
+                   help="(child) ship the journal to this host:port "
+                   "while running — set by --standby trials")
     p.add_argument("--posmap-impl", default=None,
                    choices=["flat", "recursive"],
                    help="position-map implementation under test "

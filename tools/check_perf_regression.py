@@ -64,7 +64,7 @@ LOWER_BETTER = ("_ms", "_ms_per_op", "_s")
 GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
                  "rounds", "slo_target_ms", "pipeline_depth",
-                 "evict_every", "shard_count")
+                 "evict_every", "shard_count", "tail_frames")
 
 #: result fields that are neither geometry nor a directional metric.
 #: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
@@ -327,6 +327,29 @@ def selftest(factor: float) -> None:
     regs, n = compare_latest(extract_series([e, f]), factor)
     assert n == 3 and len(regs) == 3, (
         f"sentinel self-test: same-shard-count series not gated "
+        f"({n=}, {regs})"
+    )
+    # tail_frames is GEOMETRY (ISSUE 19, bench failover_ab): the
+    # measured failover RTO scales with the durable tail the promotion
+    # replays, so a line banked at a different checkpoint interval is
+    # a different experiment — never graded against another interval's
+    # baseline, in either direction; same-interval lines must still
+    # gate each other (an RTO regression at a FIXED tail is real).
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    b["configs"]["load_scenarios"]["tail_frames"] = 64
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: a tail_frames-keyed failover line was "
+        "compared against a different-interval baseline"
+    )
+    g = mk_cap(200.0, 40.0, 3250.7)
+    h = mk_cap(200.0 / (factor * 4.0), 40.0 * factor * 4.0, 3250.7)
+    g["configs"]["load_scenarios"]["tail_frames"] = 64
+    h["configs"]["load_scenarios"]["tail_frames"] = 64
+    regs, n = compare_latest(extract_series([g, h]), factor)
+    assert n == 3 and len(regs) == 3, (
+        f"sentinel self-test: same-tail-frames series not gated "
         f"({n=}, {regs})"
     )
 
