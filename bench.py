@@ -1581,20 +1581,19 @@ def bench_server_loopback(smoke):
     the engine-only configs skip (VERDICT r2: the auth path capped the
     server at O(100) ops/s before batch verification).
 
-    The session layer needs the ``cryptography`` wheel; containers
-    without it (the builder sandbox) report a *skip*, not an error, so
-    smoke runs stay rc=0 — the driver's bench env has the wheel and
-    runs the config for real."""
+    The session layer runs on the ``cryptography`` wheel when present
+    and on the stdlib ChaCha20+HMAC port (session/stdcrypto.py) when
+    not, so this config reports real numbers in every container — the
+    historical wheel-less *skip* is gone (ISSUE 20). The active backend
+    rides the result line so banked numbers are never compared across
+    backends by accident."""
     import threading
 
     from grapevine_tpu.config import GrapevineConfig
+    from grapevine_tpu.server.client import GrapevineClient
+    from grapevine_tpu.server.service import GrapevineServer
+    from grapevine_tpu.session.channel import CRYPTO_BACKEND
     from grapevine_tpu.wire import constants as C
-
-    try:
-        from grapevine_tpu.server.client import GrapevineClient
-        from grapevine_tpu.server.service import GrapevineServer
-    except ImportError as e:
-        return {"skipped": f"no cryptography wheel ({e})"}
 
     cap, n_clients, per_client = (1 << 10, 2, 4) if smoke else (1 << 16, 16, 24)
     cfg = GrapevineConfig(
@@ -1670,11 +1669,191 @@ def bench_server_loopback(smoke):
             "phase_p99_s": phases,
             "clients": n_clients,
             "capacity_log2": cap.bit_length() - 1,
+            "crypto_backend": CRYPTO_BACKEND,
             "leakaudit": audit["verdict"],
             "leakaudit_rounds": audit["rounds_observed"],
         }
     finally:
         server.stop()
+
+
+def bench_host_pipeline_ab(smoke):
+    """Config 6b (ISSUE 20): worker-count scaling of the verify+codec
+    machinery through the multiprocess hostpipe (server/hostpipe.py) —
+    the off-GIL pool the scheduler fans batch verification across and
+    the serving layer runs session codec (AEAD open/seal + unpack +
+    validate + challenge lockstep) on.
+
+    Three arms, interleaved rep by rep: in-process (the historical
+    single-GIL path, verify only — there is no in-process pool to run
+    codec tasks on), W=1, and W=2. Per arm: sr25519 batch-verify
+    throughput over a round-sized item set, and codec throughput over
+    pipelined `open` tasks across channels sticky-routed over the pool.
+
+    Honesty: scaling is a property of the HOST, so ``host_cores`` (the
+    scheduler-visible core count) rides the line as a perf-sentinel
+    geometry key. On a single-core container W=2 physically serializes
+    — the measured speedup is the serialized floor (~1.0x), and the
+    ceiling analysis is the Amdahl projection from the measured
+    dispatch-serial fraction (parent-side pickle + pipe send, the only
+    part that cannot parallelize): what W=2 would deliver with two real
+    cores. The ≥1.7x acceptance claim is gated on ``host_cores >= 2``;
+    a single-core line reports the projection and says so in ``note``.
+    """
+    import os
+    import pickle
+    import threading
+
+    from grapevine_tpu.obs import TelemetryRegistry
+    from grapevine_tpu.server.hostpipe import HostPipeline
+    from grapevine_tpu.session import schnorrkel
+    from grapevine_tpu.session.chacha import ChallengeRng
+    from grapevine_tpu.session.channel import (
+        CRYPTO_BACKEND,
+        client_finish,
+        client_handshake,
+        server_handshake,
+    )
+    from grapevine_tpu.wire import constants as C
+    from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+    n_items, n_chan, opens_per_chan, reps = (
+        (256, 4, 8, 2) if smoke else (2048, 8, 24, 3)
+    )
+    cores = len(os.sched_getaffinity(0))
+    ctx = C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT
+
+    # one signing key per 250 identities is plenty: verify cost is
+    # per-item regardless of key reuse
+    keys = []
+    for i in range(250):
+        sk, _ = schnorrkel.expand_mini_secret(bytes([i + 1]) * 32)
+        keys.append((sk, schnorrkel.public_key(sk)))
+    items = []
+    for i in range(n_items):
+        sk, pub = keys[i % len(keys)]
+        msg = b"round-challenge-%06d" % i
+        items.append((pub, ctx, msg, schnorrkel.sign(sk, ctx, msg)))
+
+    def mk_sealed(chan, rng, n):
+        """n sealed CREATE envelopes in lockstep order for one channel."""
+        sk, pub = keys[0]
+        out = []
+        for i in range(n):
+            ch = rng.next_challenge()
+            req = QueryRequest(
+                request_type=C.REQUEST_TYPE_CREATE,
+                auth_identity=pub,
+                auth_signature=schnorrkel.sign(sk, ctx, ch),
+                record=RequestRecord(
+                    recipient=pub,
+                    payload=bytes([i & 0xFF]) * C.PAYLOAD_SIZE,
+                ),
+            )
+            out.append(chan.encrypt(req.pack()))
+        return out
+
+    def setup_pool(w):
+        pool = HostPipeline(w, registry=TelemetryRegistry())
+        pool.verify_parallel(items[: 4 * w])  # warm every worker
+        chans = []
+        for j in range(n_chan):
+            cid = b"host-ab-%08d" % j
+            state, msg1 = client_handshake()
+            reply, server_chan = server_handshake(msg1)
+            cchan = client_finish(state, reply)
+            seed = bytes([j + 1]) * 32
+            pool.attach_session(cid, server_chan, seed)
+            sealed = mk_sealed(cchan, ChallengeRng(seed),
+                               opens_per_chan * reps)
+            chans.append((cid, sealed))
+        return pool, chans
+
+    arms = {w: setup_pool(w) for w in (1, 2)}
+    best = {
+        "inproc": {"verify": 0.0},
+        1: {"verify": 0.0, "codec": 0.0},
+        2: {"verify": 0.0, "codec": 0.0},
+    }
+    schnorrkel.batch_verify(items[:8])  # warm the in-process tables
+    try:
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            assert schnorrkel.batch_verify(items)
+            best["inproc"]["verify"] = max(
+                best["inproc"]["verify"],
+                n_items / (time.perf_counter() - t0))
+            for w, (pool, chans) in arms.items():
+                t0 = time.perf_counter()
+                assert pool.verify_parallel(items)
+                best[w]["verify"] = max(
+                    best[w]["verify"],
+                    n_items / (time.perf_counter() - t0))
+                # codec: pipeline this rep's slice of every channel's
+                # sealed stream; per-channel FIFO order preserves the
+                # AEAD/challenge lockstep, channels overlap across the
+                # pool exactly as sticky routing spreads them
+                lo, hi = rep * opens_per_chan, (rep + 1) * opens_per_chan
+                t0 = time.perf_counter()
+                futs = [
+                    pool.submit("open", (cid, ct, b""), sticky=cid)
+                    for cid, sealed in chans
+                    for ct in sealed[lo:hi]
+                ]
+                for f in futs:
+                    f.result(timeout=60.0)
+                best[w]["codec"] = max(
+                    best[w]["codec"],
+                    len(futs) / (time.perf_counter() - t0))
+        # the dispatch-side serial fraction: what the parent must do
+        # alone before workers can run (chunk pickle + pipe write;
+        # measured as the pickle, the pipe write rides the same bytes)
+        t0 = time.perf_counter()
+        pickle.dumps(("schnorrkel", items))
+        t_serial = time.perf_counter() - t0
+        t_w1 = n_items / best[1]["verify"]
+        s_frac = min(1.0, t_serial / t_w1)
+        projected = 1.0 / (s_frac + (1.0 - s_frac) / 2.0)
+    finally:
+        for pool, _ in arms.values():
+            pool.close()
+
+    out = {
+        "host_cores": cores,
+        "clients": n_chan,
+        "crypto_backend": CRYPTO_BACKEND,
+        "verify_items": n_items,
+        "reps": reps,
+        "inproc": {
+            "verify_ops_per_sec": round(best["inproc"]["verify"], 1),
+        },
+    }
+    for w in (1, 2):
+        out[f"w{w}"] = {
+            "verify_ops_per_sec": round(best[w]["verify"], 1),
+            "codec_ops_per_sec": round(best[w]["codec"], 1),
+        }
+    out["speedup_verify_w2_over_w1"] = round(
+        best[2]["verify"] / best[1]["verify"], 3)
+    out["speedup_codec_w2_over_w1"] = round(
+        best[2]["codec"] / best[1]["codec"], 3)
+    out["fanout_tax_w1_over_inproc"] = round(
+        best[1]["verify"] / best["inproc"]["verify"], 3)
+    out["dispatch_serial_fraction"] = round(s_frac, 4)
+    out["projected_w2_speedup_2cores"] = round(projected, 3)
+    if cores >= 2:
+        assert out["speedup_verify_w2_over_w1"] >= 1.7, (
+            f"W=2 verify scaling {out['speedup_verify_w2_over_w1']}x "
+            f"< 1.7x on a {cores}-core host"
+        )
+    else:
+        out["note"] = (
+            "single-core container: W=2 serializes by construction, so "
+            "the measured speedup is the floor, not the machinery's "
+            "ceiling; the Amdahl projection from the measured dispatch-"
+            "serial fraction is the honest 2-core estimate"
+        )
+    return out
 
 
 def bench_slo_loopback(smoke):
@@ -1954,7 +2133,12 @@ def bench_load_scenarios(smoke):
     probe campaign (+ the red-team leak injector — an honest engine's
     transcript cannot be flipped by traffic shape alone, which is the
     point of the FP gate) must end SUSPECT and every honest scenario
-    PASS, else this config errors and ``--smoke`` fails rc!=0."""
+    PASS, else this config errors and ``--smoke`` fails rc!=0.
+
+    Second pass (ISSUE 20): the same suite reruns through the
+    multiprocess frontend — hostpipe pool + SLO-adaptive windows +
+    flush-aware collection — against a fresh engine, with the same
+    verdict acceptance plus a knee-no-worse gate vs the first pass."""
     from grapevine_tpu.config import GrapevineConfig
     from grapevine_tpu.engine.batcher import GrapevineEngine
     from grapevine_tpu.load import (
@@ -2086,6 +2270,103 @@ def bench_load_scenarios(smoke):
         f"ramp found no holding step: {out['scenarios']['ramp']}"
     )
     out["knee_ops_per_sec"] = out["scenarios"]["ramp"]["knee_ops_per_sec"]
+
+    # --- second pass: the multiprocess frontend (ISSUE 20) ------------
+    # Same engine, same calibrated schedules, but the scheduler now
+    # carries the full host pipeline: a 2-worker hostpipe pool planted
+    # for verify fan-out, the SLO-adaptive window policy fed by the
+    # workload telemetry, and a flush-aware collection window. The
+    # acceptance is behavioral, not throughput: every honest generator
+    # must still PASS the leak audit (the adaptive window is driven by
+    # public aggregates only — a contents-driven window would flip the
+    # detectors), the probe campaign must still end SUSPECT, and the
+    # knee must be no worse than the single-process same-session run.
+    from grapevine_tpu.obs import TelemetryRegistry
+    from grapevine_tpu.server.adaptive import AdaptiveBatchPolicy
+    from grapevine_tpu.server.hostpipe import HostPipeline
+
+    # fresh engine, same config + schedules: the first pass filled a
+    # meaningful fraction of the (smoke-sized) capacity, and a knee
+    # measured against a half-full tree is not comparable to one
+    # against a fresh one
+    engine.close()
+    engine = GrapevineEngine(cfg)
+    wl = WorkloadTelemetry(engine.metrics.registry, batch_size=batch)
+    engine.attach_workload(wl)
+    calibrate_unloaded_round(engine, NOW)  # warm the jit only; the
+    # schedules keep the first pass's calibrated rates for an honest
+    # same-session comparison
+
+    pool = HostPipeline(2, registry=TelemetryRegistry())
+    adaptive = AdaptiveBatchPolicy(batch, 0.008, 0.002, workload=wl)
+    delayed = getattr(engine, "_flush_step", None) is not None
+    hp: dict = {"scenarios": {}, "worker_count": 2, "adaptive_batch": True}
+    try:
+        for name, schedule in schedules.items():
+            mon = EngineLeakMonitor(
+                mb_leaves=engine.ecfg.mb.leaves,
+                rec_leaves=engine.ecfg.rec.leaves,
+                mb_choices=engine.ecfg.mb_choices,
+                # the flush-cadence detector audits the soak whenever
+                # delayed eviction is on: window stretches must never
+                # move the flush itself
+                flush_every=engine.evict_every if delayed else None,
+            )
+            sink = (
+                ProbeCampaignInjector(mon, engine.ecfg)
+                if name == "adversarial" else mon
+            )
+            engine.attach_leakmon(sink)
+            sched = BatchScheduler(engine, clock=lambda: NOW,
+                                   flush_window_ms=4.0)
+            sched.hostpipe = pool
+            sched.adaptive = adaptive
+            try:
+                runner = ScenarioRunner(sched, n_idents=64,
+                                        settle_timeout_s=120.0)
+                res = runner.run(schedule)
+            finally:
+                sched.close()
+            mon.flush(30)
+            v = mon.verdict()
+            entry = res.summary()
+            entry["leakaudit"] = v["verdict"]
+            entry["leakaudit_rounds"] = v["rounds_observed"]
+            if name == "ramp":
+                entry.update(analyze_ramp(schedule, res, target_ms))
+                entry["knee_target_ms"] = entry.pop("target_ms")
+            hp["scenarios"][name] = entry
+            mon.close()
+            engine.attach_leakmon(None)
+            print(f"[bench]   load_scenarios/hostpipe/{name}: "
+                  f"{entry.get('achieved_ops_per_sec')} ops/s, "
+                  f"p99 {entry.get('p99_commit_ms')} ms, "
+                  f"{entry['leakaudit']}", file=sys.stderr, flush=True)
+    finally:
+        pool.close()
+
+    adv = hp["scenarios"]["adversarial"]
+    assert adv["leakaudit"] == "SUSPECT" and adv["leakaudit_rounds"] > 0, (
+        f"probe campaign not SUSPECT through the frontend: {adv}"
+    )
+    for name in honest:
+        h = hp["scenarios"][name]
+        assert h["leakaudit"] == "PASS" and h["leakaudit_rounds"] > 0, (
+            f"honest scenario {name} not PASS through the frontend: {h}"
+        )
+    hp["knee_ops_per_sec"] = hp["scenarios"]["ramp"]["knee_ops_per_sec"]
+    assert hp["knee_ops_per_sec"] > 0, (
+        f"frontend ramp found no holding step: {hp['scenarios']['ramp']}"
+    )
+    # "no worse" with single-core calibration noise: a real regression
+    # halves the knee (a serialized window or a stalled pool); 0.7x is
+    # outside rep-to-rep noise on the sandbox and inside any real break
+    hp["knee_ratio_vs_inproc"] = round(
+        hp["knee_ops_per_sec"] / out["knee_ops_per_sec"], 3)
+    assert hp["knee_ratio_vs_inproc"] >= 0.7, (
+        f"multiprocess frontend degraded the knee: {hp['knee_ratio_vs_inproc']}"
+    )
+    out["hostpipe_frontend"] = hp
     return out
 
 
@@ -2421,6 +2702,7 @@ CONFIGS = [
     ("sharded", bench_sharded),
     ("sharded_evict_ab", bench_sharded_evict_ab),
     ("server_loopback", bench_server_loopback),
+    ("host_pipeline_ab", bench_host_pipeline_ab),
     ("slo_loopback", bench_slo_loopback),
     ("pipeline_ab", bench_pipeline_ab),
     ("load_scenarios", bench_load_scenarios),

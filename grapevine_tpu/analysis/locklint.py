@@ -84,6 +84,22 @@ LOCK_ALLOW: tuple = (
               "recipient_count take an unlocked reference snapshot for "
               "gauges — atomic in CPython, one round stale at worst",
               reads_only=True),
+    LockAllow("HostPipeline", "_closing",
+              "monotonic shutdown latch (False -> True once, in "
+              "close()): reader threads and submitters take unlocked "
+              "reads; a stale False risks one submit racing close — it "
+              "fails on the closed pipe with HostWorkerCrash, never a "
+              "wrong result — and a stale True only skips crash "
+              "handling the close path is about to do anyway"),
+    LockAllow("GrapevineEngine", "_rounds_since_flush",
+              "every write runs under the engine lock "
+              "(_flush_window_locked / recovery); flush_bubble_pending "
+              "takes one unlocked int read for the scheduler's window "
+              "decision — CPython-atomic, one round stale at worst, "
+              "and a stale read only mistimes a collection-window "
+              "stretch (latency, never correctness or cadence: the "
+              "flush itself still fires strictly every evict_every "
+              "rounds under the lock)", reads_only=True),
     LockAllow("GrapevineEngine", "leakmon",
               "attach-before-serve single reference assignment"),
     LockAllow("GrapevineEngine", "tracer",
@@ -491,7 +507,7 @@ def lint_sources(sources: dict, allow: tuple = LOCK_ALLOW) -> list:
     # 5. shared attributes --------------------------------------------------
     allow_by_key = {(a.cls, a.attr): a for a in allow}
     used_allows: set = set()
-    for cname in ("BatchScheduler", "GrapevineEngine"):
+    for cname in ("BatchScheduler", "GrapevineEngine", "HostPipeline"):
         cls = classes.get(cname)
         if cls is None:
             continue
@@ -569,12 +585,12 @@ def lint_sources(sources: dict, allow: tuple = LOCK_ALLOW) -> list:
 
 
 def repo_sources(root: str | None = None) -> dict:
-    """The three host-path files the lint covers, from the live tree."""
+    """The host-path files the lint covers, from the live tree."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = {}
     for rel in ("engine/batcher.py", "server/scheduler.py",
-                "engine/journal.py"):
+                "engine/journal.py", "server/hostpipe.py"):
         with open(os.path.join(root, rel)) as fh:
             out[rel] = fh.read()
     return out

@@ -162,6 +162,31 @@ def _flush_on_buffer_contents():
     return fn, {"ebuf_idx": _sds(16), "tree": _sds(32)}, ("ebuf_idx",)
 
 
+@_mutant("adaptive_batch_from_contents", "cond-predicate")
+def _adaptive_batch_from_contents():
+    """The adaptive-batching failure mode (ISSUE 20): a collection
+    window sized from queue *contents* instead of public aggregates.
+    The production policy (server/adaptive.py) decides from the queue
+    DEPTH, the arrival EWMA, and the SLO burn rates — counts and rates
+    a passive /metrics observer already sees. This mutant threads the
+    queued ops' payload bits into the window choice: op-mix-dependent
+    round cadence, visible on the wire as a recipient-correlated
+    dispatch schedule. Pins that a contents branch cannot slip into
+    the window decision unflagged."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(payloads, wait):
+        hot = jnp.sum(payloads & jnp.uint32(1))  # reads op contents
+        return lax.cond(
+            hot > 4,  # "queue looks pop-heavy: dispatch early"
+            lambda: wait // jnp.uint32(2),
+            lambda: wait,
+        )
+
+    return fn, {"payloads": _sds(16), "wait": _sds(1)}, ("payloads",)
+
+
 @_mutant("python_level_branch", "trace-dependence")
 def _python_level_branch():
     """A host-Python `if` on a traced secret — different Python paths

@@ -41,9 +41,11 @@ import numpy as np
 
 from ..config import DurabilityConfig, GrapevineConfig
 from ..testing import faults
-from ..testing.reference import HardProtocolError
 from ..wire import constants as C
 from ..wire.records import QueryRequest, QueryResponse, Record
+from ..wire.validate import validate_request  # noqa: F401  (re-export —
+# moved to the jax-free wire package so hostpipe workers can validate
+# without importing the engine; existing callers import it from here)
 from .expiry import expiry_sweep
 from .state import (
     EngineConfig,
@@ -56,17 +58,6 @@ from .state import (
 from .metrics import EngineMetrics
 from .round_step import engine_flush_step, engine_round_step
 from .step import engine_step
-
-
-def validate_request(req: QueryRequest) -> None:
-    """Fail-fast checks (reference grapevine.proto:57-64,95)."""
-    req.validate()
-    if req.auth_identity == C.ZERO_PUBKEY:
-        raise HardProtocolError("auth identity must be nonzero")
-    if not (1 <= req.request_type <= 4):
-        raise HardProtocolError(f"invalid request type {req.request_type}")
-    if req.request_type == C.REQUEST_TYPE_UPDATE and req.record.msg_id == C.ZERO_MSG_ID:
-        raise HardProtocolError("UPDATE with zero msg_id")
 
 
 def pack_batch(reqs: list[QueryRequest], batch_size: int, now: int) -> dict:
@@ -498,6 +489,14 @@ class GrapevineEngine:
         self.metrics.record_flush()
         if faults.active():
             faults.crash("flush.post_dispatch")
+        lm = self.leakmon
+        if lm is not None:
+            # flush-cadence audit (obs/leakmon.py note_flush): report
+            # the observed interval before the counter resets; only the
+            # automatic cadence is judged (count_round)
+            note = getattr(lm, "note_flush", None)
+            if note is not None:
+                note(self._rounds_since_flush, scheduled=count_round)
         self._rounds_since_flush = 0
         return True
 
@@ -508,6 +507,20 @@ class GrapevineEngine:
         schedule-independence claim is about the automatic trigger."""
         with self._lock:
             return self._flush_window_locked()
+
+    def flush_bubble_pending(self) -> bool:
+        """True between a flush dispatch and the next round dispatch:
+        the NEXT collection window overlaps the flush's device time (the
+        bubble the scheduler's flush-aware stretch fills — server/
+        scheduler.py). A pure function of the cadence counter — which is
+        itself a pure function of the round count — never of buffer
+        contents or op mix, so the stretched window leaks nothing the
+        round counter does not (the schedule-independence claim;
+        analysis/mutants.py seeds the contents-dependent variant).
+        Engine start reads as a bubble too: the first window overlaps
+        compilation, which is the same trade. Benign unlocked int read.
+        """
+        return self._flush_step is not None and self._rounds_since_flush == 0
 
     def checkpoint_now(self) -> int | None:
         """Force a sealed checkpoint of the current state (the drain

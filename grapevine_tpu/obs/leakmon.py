@@ -411,6 +411,7 @@ class EngineLeakMonitor:
         recorder: FlightRecorder | None = None,
         mb_pm_leaves: int | None = None,
         rec_pm_leaves: int | None = None,
+        flush_every: int | None = None,
     ):
         self.cfg = cfg or LeakMonitorConfig()
         self.mb_choices = mb_choices
@@ -454,6 +455,19 @@ class EngineLeakMonitor:
         #: content-sized byte on the wire is a SUSPECT exactly like an
         #: access-pattern detector tripping
         self._shipper = None
+        #: delayed-eviction flush cadence books (engine/batcher.py
+        #: _flush_window_locked): the schedule-independence claim says
+        #: the automatic flush fires strictly every ``flush_every``
+        #: dispatched rounds — a pure function of the round counter.
+        #: The engine reports each scheduled flush's observed interval
+        #: via note_flush(); any interval that deviates from the
+        #: declared cadence is content-modulated scheduling (the
+        #: flush_on_buffer_contents mutant's signature) and trips the
+        #: ``flush_cadence`` detector exactly like an access-pattern
+        #: detector. None = immediate eviction, detector absent.
+        self._flush_every = flush_every
+        self._flush_samples = 0
+        self._flush_illegal = 0
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="grapevine-leakmon"
         )
@@ -465,6 +479,7 @@ class EngineLeakMonitor:
         into the engine's own telemetry registry (one merged /metrics)."""
         ecfg = engine.ecfg
         recursive = ecfg.rec.posmap is not None
+        delayed = getattr(engine, "_flush_step", None) is not None
         return cls(
             mb_leaves=ecfg.mb.leaves,
             rec_leaves=ecfg.rec.leaves,
@@ -473,6 +488,7 @@ class EngineLeakMonitor:
             registry=engine.metrics.registry,
             mb_pm_leaves=ecfg.mb.posmap.inner_leaves if recursive else None,
             rec_pm_leaves=ecfg.rec.posmap.inner_leaves if recursive else None,
+            flush_every=engine.evict_every if delayed else None,
         )
 
     # -- round-path API (must stay O(1) and non-blocking) ---------------
@@ -492,6 +508,19 @@ class EngineLeakMonitor:
             return False
         self._submitted += 1
         return True
+
+    def note_flush(self, interval_rounds: int, scheduled: bool = True) -> None:
+        """Record one delayed-eviction flush's observed interval (rounds
+        since the previous flush; engine/batcher.py calls this under the
+        engine lock just before the cadence counter resets). Only
+        ``scheduled`` flushes are audited — flush_now() and recovery
+        completion are operator/restart actions outside the steady-state
+        cadence claim. O(1), two int bumps."""
+        if not scheduled or self._flush_every is None:
+            return
+        self._flush_samples += 1
+        if int(interval_rounds) != int(self._flush_every):
+            self._flush_illegal += 1
 
     # -- verdict views --------------------------------------------------
 
@@ -519,6 +548,19 @@ class EngineLeakMonitor:
                 "verdict": PASS if rep["cadence_ok"] else SUSPECT,
             })
             if not rep["cadence_ok"]:
+                v["verdict"] = SUSPECT
+        if self._flush_every is not None:
+            illegal = self._flush_illegal
+            v["detectors"].append({
+                "name": "flush_cadence",
+                "tree": "evict",
+                "statistic": float(illegal),
+                "threshold": 0.0,
+                "samples": int(self._flush_samples),
+                "min_samples": 1,
+                "verdict": SUSPECT if illegal else PASS,
+            })
+            if illegal:
                 v["verdict"] = SUSPECT
         return v
 

@@ -45,6 +45,13 @@ ALLOWED_LABEL_KEYS = frozenset({
     "shard",   # fleet shard index — declared small-integer topology
                # positions only (obs/fleet.py); never a member name,
                # address, or anything derived from traffic
+    "worker",  # hostpipe worker-pool index — a config-declared position
+               # (0..W-1, server/hostpipe.py), same integer-only rule as
+               # shard. A worker index is NOT a channel identity: many
+               # channels hash onto one worker and the mapping is the
+               # public sticky-routing function, but a channel_id (or
+               # anything derived from one) as a label VALUE is still
+               # rejected by the declared-values rule
 })
 
 #: Known-dangerous keys, named so the registration error can say *why*.
@@ -88,18 +95,20 @@ def _check_labels(name: str, labels: dict[str, tuple[str, ...]] | None):
                 "— label values must be enumerated at registration "
                 "(dynamic values are how identities leak into series)"
             )
-        if key == "shard":
-            # shard identity is public topology (a config-declared
-            # position), and ONLY that: integer indices. A hostname,
-            # address, or pod name as a shard value would export
-            # deployment identity through every fleet series.
+        if key in ("shard", "worker"):
+            # shard/worker identity is public topology (a config-
+            # declared position), and ONLY that: integer indices. A
+            # hostname, pod name — or a channel_id routed onto a worker
+            # — as a value would export deployment or session identity
+            # through every series.
             for v in values:
                 if not v.isascii() or not v.isdigit():
                     raise TelemetryLeakError(
-                        f"metric {name!r}: shard label value {v!r} is "
-                        "not a bare integer index — shard values are "
+                        f"metric {name!r}: {key} label value {v!r} is "
+                        "not a bare integer index — values are "
                         "declared topology positions (0..N-1), never "
-                        "member names or addresses (obs/fleet.py)"
+                        "member names, addresses, or channel ids "
+                        "(obs/fleet.py, server/hostpipe.py)"
                     )
         out[key] = values
     return out

@@ -16,8 +16,7 @@ __all__ = ["GrapevineUri", "SERVICE_NAME", "GrapevineClient", "GrapevineServer"]
 def __getattr__(name):
     # GrapevineServer stays lazy so client processes never pull in the
     # engine (jax + a device backend); GrapevineClient stays lazy so the
-    # scheduler/metrics path imports in containers without the
-    # `cryptography` wheel (session/__init__.py gates the channel layer)
+    # scheduler/metrics path never pays the session/grpc import
     if name == "GrapevineServer":
         from .service import GrapevineServer
 

@@ -374,6 +374,38 @@ def build_parser() -> argparse.ArgumentParser:
         "stays dead for the orchestrator to replace the process). "
         "Either way the crash increments grapevine_worker_crash_total",
     )
+    p.add_argument(
+        "--host-workers",
+        type=int,
+        default=0,
+        help="off-GIL host pipeline: N worker processes for session "
+        "decrypt/encode/verify, sticky by channel id (server/hostpipe.py). "
+        "0 (default) = the historical in-process path. Worker crash "
+        "policy rides --worker-restart; either way /healthz folds the "
+        "pool and crashes increment grapevine_host_worker_crash_total",
+    )
+    p.add_argument(
+        "--adaptive-batch",
+        action="store_true",
+        help="SLO-adaptive round-collection window: size each round's "
+        "wait from the arrival-rate EWMA, queue depth, and SLO burn "
+        "rates — public load aggregates only, never queue contents "
+        "(server/adaptive.py has the obliviousness argument). Default: "
+        "the static --batch-wait-ms window",
+    )
+    p.add_argument(
+        "--flush-window",
+        dest="flush_window_ms",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="flush-aware collection: when the delayed-eviction flush "
+        "(--evict-every) occupies the device, stretch the overlapping "
+        "collection window by MS milliseconds to harvest a fuller "
+        "round. The flush cadence itself stays strictly every "
+        "--evict-every rounds — this knob only retimes host-side "
+        "collection, a pure function of the public round counter",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -427,21 +459,36 @@ _REPLICATION_FLAGS = {"replicate_to", "ship_every"}
 #: primary state dir it fences at promotion
 _STANDBY_FLAGS = {"standby_listen", "promote_from"}
 
+#: the multiprocess host pipeline handles session decrypt/encode and
+#: signature verify — any role that terminates sessions (mono,
+#: frontend) or verifies rounds (engine) takes it; the fleet
+#: aggregator and the pre-promotion standby touch neither
+_HOSTPIPE_FLAGS = {"host_workers"}
+
+#: adaptive/flush-aware collection shapes the device round window, so
+#: only roles that own a BatchScheduler over an in-process engine take
+#: them — a frontend supplying --adaptive-batch would silently shape
+#: nothing (its rounds are collected in the engine tier)
+_ADAPTIVE_FLAGS = {"adaptive_batch", "flush_window_ms"}
+
 _ROLE_FLAGS = {
     "mono": {"listen", "tls_cert", "tls_key", "expiry_period",
              "msg_capacity", "recipient_capacity", "batch_size",
              "batch_wait_ms", "seed", "identity_seed", "verbose", "role",
              "metrics_port", "metrics_host"}
             | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
-            | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS,
+            | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS
+            | _HOSTPIPE_FLAGS | _ADAPTIVE_FLAGS,
     "engine": {"engine_listen", "expiry_period", "msg_capacity",
                "recipient_capacity", "batch_size", "batch_wait_ms",
                "seed", "verbose", "role", "metrics_port", "metrics_host"}
               | _LEAKMON_FLAGS | _DURABILITY_FLAGS | _TRACE_SLO_FLAGS
-              | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS,
+              | _ENGINE_GEOM_FLAGS | _REPLICATION_FLAGS
+              | _HOSTPIPE_FLAGS | _ADAPTIVE_FLAGS,
     "frontend": {"engine", "listen", "tls_cert", "tls_key",
                  "batch_size", "identity_seed", "verbose", "role",
-                 "metrics_port", "metrics_host"},
+                 "metrics_port", "metrics_host", "worker_restart"}
+                | _HOSTPIPE_FLAGS,
     # the fleet role owns no device, no listener, no sessions: it
     # scrapes declared members and serves the merged view — the only
     # non-fleet flag it takes is the bind interface
@@ -456,7 +503,8 @@ _ROLE_FLAGS = {
                 "batch_wait_ms", "engine_listen", "metrics_port",
                 "metrics_host"}
                | _STANDBY_FLAGS | _DURABILITY_FLAGS | _LEAKMON_FLAGS
-               | _TRACE_SLO_FLAGS | _ENGINE_GEOM_FLAGS,
+               | _TRACE_SLO_FLAGS | _ENGINE_GEOM_FLAGS
+               | _ADAPTIVE_FLAGS,
 }
 
 
@@ -654,6 +702,8 @@ def main(argv=None) -> int:
             worker_restart=args.worker_restart,
             trace_ring_size=args.trace_ring_size, slo=_slo_config(args),
             profile_enable=args.profile_enable,
+            adaptive_batch=args.adaptive_batch,
+            flush_window_ms=args.flush_window_ms,
         )
         eport = server.start(args.engine_listen)
         print(f"promoted engine tier listening on port {eport}",
@@ -679,7 +729,10 @@ def main(argv=None) -> int:
                               slo=_slo_config(args),
                               profile_enable=args.profile_enable,
                               replicate_to=args.replicate_to,
-                              ship_every=args.ship_every)
+                              ship_every=args.ship_every,
+                              host_workers=args.host_workers,
+                              adaptive_batch=args.adaptive_batch,
+                              flush_window_ms=args.flush_window_ms)
         port = engine.start(args.engine_listen)
         print(f"grapevine-tpu engine tier listening on port {port}",
               flush=True)
@@ -703,11 +756,12 @@ def main(argv=None) -> int:
         from .tier import FrontendServer
 
         server = FrontendServer(args.engine, config=config,
-                                identity=identity)
+                                identity=identity,
+                                host_workers=args.host_workers,
+                                worker_restart=args.worker_restart)
     else:
-        # imported here (not at module top) so role/flag validation and
-        # the engine role work in containers without the session layer's
-        # `cryptography` dependency
+        # imported here (not at module top) so role/flag validation
+        # fails fast without paying the session/service import
         from .service import GrapevineServer
 
         server = GrapevineServer(
@@ -720,6 +774,9 @@ def main(argv=None) -> int:
             profile_enable=args.profile_enable,
             replicate_to=args.replicate_to,
             ship_every=args.ship_every,
+            host_workers=args.host_workers,
+            adaptive_batch=args.adaptive_batch,
+            flush_window_ms=args.flush_window_ms,
         )
     tls_cert = open(args.tls_cert, "rb").read() if args.tls_cert else None
     tls_key = open(args.tls_key, "rb").read() if args.tls_key else None

@@ -59,6 +59,7 @@ class BatchScheduler:
         scheme=None,
         restart_on_crash: bool = False,
         pipeline_depth: int | None = None,
+        flush_window_ms: float | None = None,
     ):
         self.engine = engine
         self.max_wait = max_wait_ms / 1000.0
@@ -81,10 +82,41 @@ class BatchScheduler:
         #: signature scheme module (sign/verify/batch_verify); default is
         #: the reference-compatible sr25519 (session/schnorrkel.py)
         self.scheme = scheme or schnorrkel
+        #: optional multiprocess verify fan-out (server/hostpipe.py):
+        #: when GrapevineServer runs a host pipeline it plants the pool
+        #: here, and the round's first-pass batch_verify splits across
+        #: worker processes. None = the historical in-process MSM.
+        self.hostpipe = None
+        #: optional SLO-adaptive window policy (server/adaptive.py),
+        #: planted by the serving layer after observability attaches;
+        #: None = the static max_wait/idle_gap/full-batch window
+        self.adaptive = None
+        #: flush-aware collection (server/adaptive.py module docstring
+        #: has the obliviousness argument): when the engine reports a
+        #: delayed-eviction flush is on the device (flush_bubble_pending
+        #: — a pure function of the round counter), the next collection
+        #: window may stretch by this declared extra wait, harvesting
+        #: arrivals into a fuller round instead of dispatching a thin
+        #: round that queues behind the flush anyway. None/0 = off.
+        self.flush_window = (flush_window_ms or 0.0) / 1000.0
+        if self.flush_window < 0:
+            raise ValueError("flush_window_ms must be >= 0")
         #: batch-level telemetry sink (engine/metrics.py on an
         #: obs.TelemetryRegistry); the scheduler records into the
         #: engine's registry so /metrics serves one merged view
         self.metrics = getattr(engine, "metrics", None)
+        self._c_flush_stretch = None
+        registry = getattr(self.metrics, "registry", None)
+        if self.flush_window > 0 and registry is not None:
+            # successive schedulers over one engine (bench arms, standby
+            # promotion) share the counter instead of re-registering
+            existing = registry.get(
+                "grapevine_host_flush_window_stretches_total")
+            self._c_flush_stretch = existing if existing is not None \
+                else registry.counter(
+                "grapevine_host_flush_window_stretches_total",
+                "collection windows stretched into a delayed-eviction "
+                "flush bubble (--flush-window; round-count cadence only)")
         #: (request, auth, future, perf_counter enqueue time)
         self._queue: list[
             tuple[QueryRequest, AuthItem | None, Future, float]
@@ -270,6 +302,31 @@ class BatchScheduler:
                     self._cv.wait()
                 if self._closed and not self._queue and not ledger:
                     return
+                has_work = bool(self._queue)
+                depth0 = len(self._queue)
+            # per-round window decision OUTSIDE the cv (the burn-rate
+            # scans and registry samples must never extend the
+            # collector's critical section — the note_arrival stance).
+            # Inputs are public aggregates only: the queue DEPTH (an
+            # integer), the arrival EWMA, the SLO burn rates, and the
+            # engine's round-counter flush cadence — never queue or
+            # buffer contents (server/adaptive.py; CI seeds the
+            # contents-dependent mutants).
+            w_wait, w_gap, w_target = self.max_wait, self.idle_gap, bs
+            if has_work:
+                if self.adaptive is not None:
+                    w_wait, w_gap, w_target = self.adaptive.decide(depth0)
+                if self.flush_window > 0 and getattr(
+                    self.engine, "flush_bubble_pending", lambda: False
+                )():
+                    # the device is busy with the delayed-eviction flush
+                    # (a round-count fact): stretch this window into the
+                    # bubble and harvest a fuller round
+                    w_wait += self.flush_window
+                    w_target = bs
+                    if self._c_flush_stretch is not None:
+                        self._c_flush_stretch.inc()
+            with self._cv:
                 chunk = []
                 if self._queue:
                     # Quiescence-based collection: a client wave
@@ -279,18 +336,18 @@ class BatchScheduler:
                     # only the fastest few (measured 26% occupancy at 8
                     # clients). Keep the window open while arrivals are
                     # still trickling in (inter-arrival gap < idle_gap),
-                    # capped at max_wait total; a lone client still
-                    # commits after idle_gap. The wait runs while the
-                    # device executes the previous round (see below), so
-                    # it costs no device idle time under load.
+                    # capped at the window's wait total; a lone client
+                    # still commits after the idle gap. The wait runs
+                    # while the device executes the previous round (see
+                    # below), so it costs no device idle time under load.
                     t_asm0 = time.monotonic()
                     t_asm0_pc = time.perf_counter()  # tracer clock
-                    deadline = t_asm0 + self.max_wait
+                    deadline = t_asm0 + w_wait
                     hit_cap = False
-                    while len(self._queue) < bs and not self._closed:
+                    while len(self._queue) < w_target and not self._closed:
                         now = time.monotonic()
                         wait_until = min(
-                            deadline, self._last_enqueue + self.idle_gap
+                            deadline, self._last_enqueue + w_gap
                         )
                         if now >= wait_until:
                             hit_cap = now >= deadline
@@ -379,12 +436,29 @@ class BatchScheduler:
                 # clients are answered promptly and close() can drain
                 settle_head()
 
+    def _batch_verify_fanout(self, items) -> bool:
+        """First-pass batch verify, fanned across the hostpipe pool when
+        one is attached. The happy path (everything verifies) gets the
+        multiprocess speedup; a False answer hands off to the inline
+        bisect below, which stays in-process — failure is the attacker-
+        funded path and does not deserve the parallel hardware. Any pool
+        fault degrades to the in-process MSM rather than rejecting
+        honest traffic."""
+        if self.hostpipe is not None:
+            from .hostpipe import HostPipeError
+
+            try:
+                return self.hostpipe.verify_parallel(items)
+            except HostPipeError:
+                pass  # degraded pool: verified correctness beats speed
+        return bool(self.scheme.batch_verify(items))
+
     def _verify_chunk(self, chunk):
         """Batch signature verification; returns surviving (req, fut)."""
         # --- one multi-scalar multiplication for the round ------------
         authed = [i for i, (_, a, _, _) in enumerate(chunk) if a is not None]
         rejected: set[int] = set()
-        if authed and not self.scheme.batch_verify(
+        if authed and not self._batch_verify_fanout(
             [chunk[i][1] for i in authed]
         ):
             # bisect to the offenders: O(bad · log n) batch checks, so

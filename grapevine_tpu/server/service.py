@@ -29,14 +29,10 @@ import grpc
 from ..config import GrapevineConfig
 from ..engine.batcher import GrapevineEngine, validate_request
 
-try:
-    from ..session import channel as chan
-except ModuleNotFoundError:
-    # The channel layer needs the 'cryptography' wheel. The engine tier
-    # (server/tier.py) imports this module only for run_expiry_loop and
-    # must keep working without it; constructing the session-terminating
-    # GrapevineServer without the wheel still fails, now at first use.
-    chan = None
+# the channel layer selects its backend itself: the cryptography wheel
+# when present, else the stdlib port (session/stdcrypto.py) — this
+# import succeeds in every container
+from ..session import channel as chan
 from ..session.chacha import ChallengeRng
 from ..testing.reference import HardProtocolError
 from ..wire import constants as C
@@ -69,7 +65,8 @@ def run_expiry_loop(engine, config, stop_event, clock, health=None):
 
 
 class _Session:
-    __slots__ = ("channel", "challenge_rng", "created", "last_used", "lock")
+    __slots__ = ("channel", "challenge_rng", "created", "last_used", "lock",
+                 "worker", "worker_epoch")
 
     def __init__(self, secure_channel: chan.SecureChannel, seed: bytes):
         self.channel = secure_channel
@@ -77,6 +74,13 @@ class _Session:
         self.created = time.time()
         self.last_used = self.created
         self.lock = threading.Lock()
+        #: hostpipe sticky worker (index, epoch-at-attach) when the
+        #: session's cipher states live in a worker process; None = the
+        #: in-process path. A crashed worker bumps its epoch, so a stale
+        #: session can never resume against a respawned worker's empty
+        #: session map with desynced counters.
+        self.worker: int | None = None
+        self.worker_epoch = 0
 
 
 class GrapevineServer:
@@ -101,6 +105,9 @@ class GrapevineServer:
         profile_enable: bool = False,
         replicate_to: str | None = None,
         ship_every: int = 1,
+        host_workers: int = 0,
+        adaptive_batch: bool = False,
+        flush_window_ms: float | None = None,
     ):
         self.config = config or GrapevineConfig()
         if scheduler is not None and replicate_to is not None:
@@ -115,6 +122,12 @@ class GrapevineServer:
                 raise ValueError(
                     "durability needs the device engine in-process (the "
                     "frontend role has no state to checkpoint)"
+                )
+            if adaptive_batch or flush_window_ms:
+                raise ValueError(
+                    "adaptive/flush-aware batching shapes the device "
+                    "round collection window — only the engine owner "
+                    "has one (the frontend forwards ops unbatched)"
                 )
             self.engine = None
             self.scheduler = scheduler
@@ -134,6 +147,7 @@ class GrapevineServer:
                 clock=clock,
                 scheme=get_signature_scheme(self.config.signature_scheme),
                 restart_on_crash=worker_restart,
+                flush_window_ms=flush_window_ms,
                 **sched_kwargs,
             )
         self.attestation = attestation or chan.NullAttestation()
@@ -161,6 +175,25 @@ class GrapevineServer:
         self._g_sessions = self.metrics_registry.gauge(
             "grapevine_sessions", "live authenticated sessions"
         )
+        #: multiprocess verify/codec pipeline (server/hostpipe.py):
+        #: 0 = the historical in-process path, N = a pool of N worker
+        #: processes holding the session cipher states sticky by
+        #: channel_id. Crash policy rides worker_restart, like the
+        #: batch collector.
+        self.hostpipe = None
+        if host_workers:
+            from .hostpipe import HostPipeline
+
+            self.hostpipe = HostPipeline(
+                host_workers,
+                scheme=self.config.signature_scheme,
+                restart_on_crash=worker_restart,
+                registry=self.metrics_registry,
+            )
+            self.hostpipe.on_crash(self._drop_worker_sessions)
+            if self.engine is not None:
+                # scheduler-side verify fan-out shares the same pool
+                self.scheduler.hostpipe = self.hostpipe
         self._metrics_server = None
         #: continuous obliviousness auditing (obs/leakmon.py): pass a
         #: LeakMonitorConfig to watch every round's transcript. Device-
@@ -205,6 +238,21 @@ class GrapevineServer:
                     profile_enable=profile_enable,
                 )
             )
+            if adaptive_batch:
+                # SLO-adaptive window sizing (server/adaptive.py has the
+                # policy and its obliviousness argument). Planted after
+                # observability attaches so the policy reads the same
+                # arrival EWMA and burn rates /metrics exports.
+                from .adaptive import AdaptiveBatchPolicy
+
+                self.scheduler.adaptive = AdaptiveBatchPolicy(
+                    self.engine.ecfg.batch_size,
+                    self.scheduler.max_wait,
+                    self.scheduler.idle_gap,
+                    workload=self.engine.workload,
+                    slo=self.slo,
+                    registry=self.metrics_registry,
+                )
 
     # -- RPC handlers (raw-bytes serializers) ---------------------------
 
@@ -222,9 +270,24 @@ class GrapevineServer:
         # and immune to session-clobbering via a replayed client pubkey
         token = os.urandom(SESSION_TOKEN_SIZE)
         encrypted_seed = secure_channel.encrypt(seed + token)
+        session = _Session(secure_channel, seed)
+        if self.hostpipe is not None:
+            from .hostpipe import HostPipeError
+
+            # hand the cipher states (counters included: send_n is 1
+            # after the seed ciphertext above) to the sticky worker
+            # BEFORE the client can learn the token from our reply
+            try:
+                session.worker, session.worker_epoch = (
+                    self.hostpipe.attach_session(token, secure_channel, seed)
+                )
+            except HostPipeError as exc:
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE, f"host pipeline: {exc}"
+                )
         with self._sessions_lock:
             self._evict_sessions_locked()
-            self._sessions[token] = _Session(secure_channel, seed)
+            self._sessions[token] = session
             self._g_sessions.set(len(self._sessions))
         return pw.encode_auth_with_seed(
             pw.AuthMessageWithChallengeSeed(
@@ -239,10 +302,40 @@ class GrapevineServer:
         if self.session_ttl > 0:
             dead = [k for k, s in self._sessions.items() if now - s.last_used > self.session_ttl]
             for k in dead:
-                del self._sessions[k]
+                self._forget_session_locked(k)
         while len(self._sessions) >= self.max_sessions:
             oldest = min(self._sessions, key=lambda k: self._sessions[k].last_used)
-            del self._sessions[oldest]
+            self._forget_session_locked(oldest)
+
+    def _forget_session_locked(self, token: bytes):
+        session = self._sessions.pop(token, None)
+        if (
+            session is not None
+            and session.worker is not None
+            and self.hostpipe is not None
+        ):
+            # fire-and-forget: the worker's copy of the cipher state is
+            # garbage once the registry forgets the token
+            self.hostpipe.detach_session(token)
+
+    def _drop_worker_sessions(self, worker_index: int):
+        """hostpipe crash listener: every session stuck to the dead
+        worker lost its cipher states — drop them so clients get a
+        clean UNAUTHENTICATED and re-auth, instead of a decrypt loop
+        against a respawned worker that never knew them."""
+        with self._sessions_lock:
+            dead = [
+                k for k, s in self._sessions.items()
+                if s.worker == worker_index
+            ]
+            for k in dead:
+                del self._sessions[k]
+            self._g_sessions.set(len(self._sessions))
+        if dead:
+            log.warning(
+                "dropped %d sessions stuck to dead hostpipe worker %d",
+                len(dead), worker_index,
+            )
 
     def _query(self, request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
         try:
@@ -259,11 +352,13 @@ class GrapevineServer:
                 and self.session_ttl > 0
                 and now - session.last_used > self.session_ttl
             ):
-                del self._sessions[envelope.channel_id]
+                self._forget_session_locked(envelope.channel_id)
                 self._g_sessions.set(len(self._sessions))
                 session = None
         if session is None:
             context.abort(grpc.StatusCode.UNAUTHENTICATED, "unknown channel")
+        if session.worker is not None:
+            return self._query_hostpipe(envelope, session, now, context)
         with session.lock:
             # AEAD authentication FIRST: a replayed or injected envelope
             # (channel_id travels in the clear) must fail here without
@@ -308,6 +403,79 @@ class GrapevineServer:
                 # against a serving replica
                 context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
             ciphertext = session.channel.encrypt(resp.pack())
+        return pw.encode_envelope(pw.EnvelopeMessage(data=ciphertext))
+
+    def _query_hostpipe(self, envelope, session, now, context) -> bytes:
+        """The multiprocess Query path: AEAD open, challenge draw,
+        unpack/validate, and the response seal all run on the session's
+        sticky hostpipe worker — same semantics as the inline path in
+        :meth:`_query` (auth-first, lockstep, fail-fast), same status
+        codes, but the GIL-bound work is off this process."""
+        from .hostpipe import (
+            HostAuthError,
+            HostInvalidRequest,
+            HostPipeError,
+        )
+
+        pipe = self.hostpipe
+        token = envelope.channel_id
+        with session.lock:
+            if pipe.epoch_of(session.worker) != session.worker_epoch:
+                # the sticky worker died after this session was looked
+                # up (the crash listener races this request): its cipher
+                # states are gone — drop and force a re-auth
+                with self._sessions_lock:
+                    self._sessions.pop(token, None)
+                    self._g_sessions.set(len(self._sessions))
+                context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED,
+                    "session lost to a host worker restart",
+                )
+            try:
+                req, challenge = pipe.open_request(
+                    token, envelope.data, envelope.aad
+                )
+            except HostAuthError:
+                context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED, "decryption failed"
+                )
+            except HostInvalidRequest as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            except HostPipeError:
+                with self._sessions_lock:
+                    self._forget_session_locked(token)
+                    self._g_sessions.set(len(self._sessions))
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "host worker lost; re-authenticate",
+                )
+            session.last_used = now
+            try:
+                resp = self.scheduler.submit(
+                    req,
+                    auth=(
+                        req.auth_identity,
+                        C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
+                        challenge,
+                        req.auth_signature,
+                    ),
+                )
+            except AuthFailure:
+                context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED, "bad challenge signature"
+                )
+            except SchedulerShutdown as exc:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+            try:
+                ciphertext = pipe.seal_response(token, resp.pack())
+            except HostPipeError:
+                with self._sessions_lock:
+                    self._forget_session_locked(token)
+                    self._g_sessions.set(len(self._sessions))
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "host worker lost; re-authenticate",
+                )
         return pw.encode_envelope(pw.EnvelopeMessage(data=ciphertext))
 
     # -- lifecycle ------------------------------------------------------
@@ -400,6 +568,14 @@ class GrapevineServer:
                 # last-durable-round + recovery progress (batch-level
                 # sequence numbers only) — the RPO a probe can alert on
                 detail["durability"] = self.engine.durability.status()
+        if self.hostpipe is not None:
+            # a dead verify/codec worker with restart off means part of
+            # the session space can never decrypt again — stop routing
+            # here so a supervisor can recycle the process
+            alive = self.hostpipe.alive()
+            detail["host_workers_alive"] = self.hostpipe.alive_count()
+            detail["host_workers"] = self.hostpipe.workers
+            healthy = healthy and alive
         if self.shipper is not None:
             detail["replication"] = self.shipper.stats()
             # a fatally-fenced shipper means a standby promoted out from
@@ -475,6 +651,8 @@ class GrapevineServer:
         if self.shipper is not None:
             self.shipper.close()
         self.scheduler.close()
+        if self.hostpipe is not None:
+            self.hostpipe.close()
         if self.leakmon is not None:
             self.leakmon.close()
         if self.engine is not None:

@@ -67,7 +67,9 @@ class EngineServer:
                  durability=None, worker_restart: bool = False,
                  trace_ring_size: int = 512, slo=None,
                  profile_enable: bool = False, engine=None,
-                 replicate_to: str | None = None, ship_every: int = 1):
+                 replicate_to: str | None = None, ship_every: int = 1,
+                 host_workers: int = 0, adaptive_batch: bool = False,
+                 flush_window_ms: float | None = None):
         from ..engine.batcher import GrapevineEngine
         from ..session import get_signature_scheme
         from .scheduler import BatchScheduler
@@ -121,8 +123,37 @@ class EngineServer:
             clock=clock,
             scheme=get_signature_scheme(self.config.signature_scheme),
             restart_on_crash=worker_restart,
+            flush_window_ms=flush_window_ms,
             **kwargs,
         )
+        if adaptive_batch:
+            # SLO-adaptive window sizing (server/adaptive.py): planted
+            # after observability attaches so the policy reads the same
+            # public arrival EWMA and burn rates /metrics exports
+            from .adaptive import AdaptiveBatchPolicy
+
+            self.scheduler.adaptive = AdaptiveBatchPolicy(
+                self.engine.ecfg.batch_size,
+                self.scheduler.max_wait,
+                self.scheduler.idle_gap,
+                workload=self.engine.workload,
+                slo=self.slo,
+                registry=self.engine.metrics.registry,
+            )
+        #: optional verify fan-out pool: the engine tier holds no
+        #: sessions, so its hostpipe does nothing but split the round's
+        #: batch-verify MSM across worker processes (scheduler.py)
+        self.hostpipe = None
+        if host_workers:
+            from .hostpipe import HostPipeline
+
+            self.hostpipe = HostPipeline(
+                host_workers,
+                scheme=self.config.signature_scheme,
+                restart_on_crash=worker_restart,
+                registry=self.engine.metrics.registry,
+            )
+            self.scheduler.hostpipe = self.hostpipe
         self._grpc_server: grpc.Server | None = None
         self.clock = clock or (lambda: int(_time.time()))
         self._expiry_stop = threading.Event()
@@ -214,6 +245,13 @@ class EngineServer:
         }
         if self.engine.durability is not None:
             detail["durability"] = self.engine.durability.status()
+        if self.hostpipe is not None:
+            # degraded verify pool: the scheduler degrades to in-process
+            # verification (still correct), but the capacity loss should
+            # page — same stance as the monolithic server's fold
+            detail["host_workers_alive"] = self.hostpipe.alive_count()
+            detail["host_workers"] = self.hostpipe.workers
+            healthy = healthy and self.hostpipe.alive()
         if self.shipper is not None:
             detail["replication"] = self.shipper.stats()
             # a fatally-fenced shipper means a standby promoted out from
@@ -276,6 +314,8 @@ class EngineServer:
         if self.shipper is not None:
             self.shipper.close()
         self.scheduler.close()
+        if self.hostpipe is not None:
+            self.hostpipe.close()
         if self.leakmon is not None:
             self.leakmon.close()
         if checkpoint:
@@ -368,13 +408,18 @@ class FrontendServer:
 
     def __init__(self, engine_address: str, config: GrapevineConfig | None = None,
                  attestation=None, clock=None, session_ttl: float = 3600.0,
-                 max_sessions: int = 4096, identity=None):
+                 max_sessions: int = 4096, identity=None,
+                 host_workers: int = 0, worker_restart: bool = False):
         from .service import GrapevineServer
 
         # The monolithic server with its scheduler swapped for the
         # engine-tier RPC stub (GrapevineServer's injected-scheduler
         # mode): every session/auth behavior and its tests carry over
         # unchanged, and there is no device engine in this process.
+        # ``host_workers`` is where the multiprocess verify/codec
+        # pipeline pays off most: the frontend IS the host-crypto tier,
+        # so its sessions fan out across worker processes while the
+        # engine tier keeps the device.
         stub = _EngineStub(engine_address)
         self._inner = GrapevineServer(
             config=config,
@@ -384,6 +429,8 @@ class FrontendServer:
             max_sessions=max_sessions,
             identity=identity,
             scheduler=stub,
+            host_workers=host_workers,
+            worker_restart=worker_restart,
         )
         stub.bind_registry(self._inner.metrics_registry)
 

@@ -31,36 +31,17 @@ from .ristretto import (  # noqa: F401
     verify,
 )
 
-try:
-    from .channel import (  # noqa: F401
-        NullAttestation,
-        SecureChannel,
-        client_handshake,
-        server_handshake,
-    )
-except ModuleNotFoundError as _exc:  # pragma: no cover - env-dependent
-    # The encrypted-channel layer needs the `cryptography` wheel; the
-    # signature schemes and challenge RNG are pure Python. Gate instead
-    # of failing the whole package import so device-side users (engine,
-    # scheduler, metrics endpoint) work in minimal containers — touching
-    # the channel API still raises the original error, lazily. Only that
-    # one dependency is gated: any other missing module is a real bug
-    # and must fail loudly at import, not at the first handshake.
-    if (_exc.name or "").split(".")[0] != "cryptography":
-        raise
-    _channel_import_error = _exc
-
-    def __getattr__(name: str):
-        if name in (
-            "NullAttestation",
-            "SecureChannel",
-            "client_handshake",
-            "server_handshake",
-        ):
-            raise ModuleNotFoundError(
-                f"session.{name} requires the 'cryptography' package"
-            ) from _channel_import_error
-        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+# The channel layer runs on either crypto backend: the `cryptography`
+# wheel when present (OpenSSL, constant-time), else the stdlib + numpy
+# fallback (session/stdcrypto.py) — bit-compatible wire format either
+# way, so this import never needs the historical wheel gate.
+from .channel import (  # noqa: F401
+    CRYPTO_BACKEND,
+    NullAttestation,
+    SecureChannel,
+    client_handshake,
+    server_handshake,
+)
 
 
 def get_signature_scheme(name: str):

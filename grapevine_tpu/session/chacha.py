@@ -25,7 +25,7 @@ try:  # OpenSSL ChaCha20: 16-byte nonce = LE32 initial counter ‖ RFC nonce
     from cryptography.hazmat.primitives.ciphers.algorithms import (
         ChaCha20 as _OpenSSLChaCha20,
     )
-except ImportError:  # pragma: no cover - cryptography is a hard dep
+except ImportError:  # wheel-less container: numpy keystream fallback
     _Cipher = None
 
 
@@ -60,6 +60,8 @@ class ChaCha20:
         self._const = struct.unpack("<4I", b"expand 32-byte k")
         self._key = struct.unpack("<8I", key)
         self._nonce = struct.unpack("<3I", nonce)
+        self._key_bytes = key
+        self._nonce_bytes = nonce
         self._counter = counter
         self._buf = b""
         self._openssl = None
@@ -87,9 +89,18 @@ class ChaCha20:
     def keystream(self, n: int) -> bytes:
         if self._openssl is not None:
             return self._openssl.update(bytes(n))
-        while len(self._buf) < n:
-            self._buf += self._block(self._counter)
-            self._counter += 1
+        if len(self._buf) < n:
+            # wheel-less fallback: draw whole blocks from the numpy
+            # block-axis keystream (stdcrypto.py) instead of the 91 µs
+            # pure-Python block — _block stays as the spec oracle the
+            # tests pin both streams against
+            from . import stdcrypto
+
+            n_blocks = (n - len(self._buf) + 63) // 64
+            self._buf += stdcrypto.chacha20_keystream(
+                self._key_bytes, self._nonce_bytes, n_blocks * 64, self._counter
+            )
+            self._counter += n_blocks
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 

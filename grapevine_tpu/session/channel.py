@@ -46,13 +46,35 @@ import hashlib
 import os
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+
+    CRYPTO_BACKEND = "cryptography"
+
+    def _hkdf(ikm: bytes, salt: bytes, info: bytes, length: int) -> bytes:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=length, salt=salt, info=info
+        ).derive(ikm)
+
+except ModuleNotFoundError:
+    # Wheel-less container: the stdlib + numpy backend (stdcrypto.py) is
+    # bit-compatible by RFC construction, so channels interoperate across
+    # backends — a stdlib client speaks to a wheel-backed server and
+    # vice versa (pinned in tests/test_stdcrypto.py when both exist).
+    from .stdcrypto import (
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hkdf_sha256 as _hkdf,
+    )
+
+    CRYPTO_BACKEND = "stdlib"
 
 _HKDF_INFO = b"grapevine-tpu-channel-ix-v1"
 _HS_INFO = b"grapevine-tpu-ix-handshake"
@@ -76,8 +98,18 @@ class SecureChannel:
     def __init__(self, send_key: bytes, recv_key: bytes):
         self._send = ChaCha20Poly1305(send_key)
         self._recv = ChaCha20Poly1305(recv_key)
+        self._send_keyb = send_key
+        self._recv_keyb = recv_key
         self._send_n = 0
         self._recv_n = 0
+
+    def export_keys(self) -> tuple[bytes, bytes, int, int]:
+        """(send_key, recv_key, send_n, recv_n) — the hostpipe session
+        hand-off (server/hostpipe.py): the sticky worker rebuilds both
+        directional cipher states, counters included, in its own
+        process; this side must stop using the channel afterwards or
+        the nonce counters fork."""
+        return self._send_keyb, self._recv_keyb, self._send_n, self._recv_n
 
     @staticmethod
     def _nonce(counter: int) -> bytes:
@@ -98,17 +130,13 @@ def _derive_channel(
     ee: bytes, es: bytes, se: bytes, transcript: bytes
 ) -> tuple[bytes, bytes]:
     """(k_c2s, k_s2c) from the concatenated DH outputs + transcript."""
-    okm = HKDF(
-        algorithm=hashes.SHA256(), length=64, salt=transcript, info=_HKDF_INFO
-    ).derive(ee + es + se)
+    okm = _hkdf(ee + es + se, transcript, _HKDF_INFO, 64)
     return okm[:32], okm[32:]
 
 
 def _hs_key(ee: bytes, transcript: bytes) -> bytes:
     """Handshake-message key: encrypts the server static + evidence."""
-    return HKDF(
-        algorithm=hashes.SHA256(), length=32, salt=transcript, info=_HS_INFO
-    ).derive(ee)
+    return _hkdf(ee, transcript, _HS_INFO, 32)
 
 
 class ServerIdentity:

@@ -1,0 +1,27 @@
+"""Wire-level request validation, shared by the engine batcher and the
+hostpipe codec workers.
+
+Lives in the wire package (not engine/batcher.py, its original home)
+because hostpipe worker processes (server/hostpipe.py) validate
+requests off-GIL and must not import the engine — engine/batcher.py
+pulls in jax + a device backend, and a spawn-context worker paying a
+device-runtime import per process would erase the point of the pool.
+engine/batcher.py re-exports this for its existing callers.
+"""
+
+from __future__ import annotations
+
+from ..testing.reference import HardProtocolError
+from . import constants as C
+from .records import QueryRequest
+
+
+def validate_request(req: QueryRequest) -> None:
+    """Fail-fast checks (reference grapevine.proto:57-64,95)."""
+    req.validate()
+    if req.auth_identity == C.ZERO_PUBKEY:
+        raise HardProtocolError("auth identity must be nonzero")
+    if not (1 <= req.request_type <= 4):
+        raise HardProtocolError(f"invalid request type {req.request_type}")
+    if req.request_type == C.REQUEST_TYPE_UPDATE and req.record.msg_id == C.ZERO_MSG_ID:
+        raise HardProtocolError("UPDATE with zero msg_id")

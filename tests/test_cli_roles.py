@@ -101,6 +101,17 @@ def _check(argv):
      "ab" * 32],
     ["--role", "standby", "--state-dir", "/x",
      "--fleet-members", "h0:1"],
+    # the host pipeline terminates sessions (mono, frontend) or
+    # verifies rounds (engine); the fleet aggregator and the
+    # pre-promotion standby touch neither (ISSUE 20)
+    ["--role", "fleet", "--fleet-members", "h0:1", "--host-workers", "2"],
+    ["--role", "standby", "--state-dir", "/x", "--host-workers", "2"],
+    # adaptive/flush-aware collection shapes the device round window —
+    # a frontend supplying it would silently shape nothing (its rounds
+    # are collected in the engine tier)
+    ["--role", "frontend", "--engine", "h:1", "--adaptive-batch"],
+    ["--role", "frontend", "--engine", "h:1", "--flush-window", "4"],
+    ["--role", "fleet", "--fleet-members", "h0:1", "--adaptive-batch"],
 ])
 def test_misapplied_flags_rejected(argv):
     with pytest.raises(SystemExit, match="does not take"):
@@ -176,6 +187,19 @@ def test_misapplied_flags_rejected(argv):
     ["--role", "standby", "--state-dir", "/x", "--evict-every", "4",
      "--pipeline-depth", "1", "--tree-top-cache-levels", "0",
      "--metrics-port", "0"],
+    # the host pipeline + adaptive/flush knobs (ISSUE 20): every
+    # session-terminating or round-verifying role takes --host-workers;
+    # the frontend also takes --worker-restart (hostpipe crash policy,
+    # no durability implied); adaptive windows belong to roles owning
+    # a BatchScheduler over an in-process engine (mono/engine/standby)
+    ["--role", "mono", "--host-workers", "2", "--adaptive-batch",
+     "--flush-window", "4"],
+    ["--role", "engine", "--engine-listen", "127.0.0.1:0",
+     "--host-workers", "2", "--adaptive-batch"],
+    ["--role", "frontend", "--engine", "127.0.0.1:4000",
+     "--host-workers", "2", "--worker-restart"],
+    ["--role", "standby", "--state-dir", "/x", "--adaptive-batch",
+     "--flush-window", "4"],
 ])
 def test_valid_role_flag_combinations_accepted(argv):
     _check(argv)  # must not raise

@@ -1,0 +1,346 @@
+"""Multiprocess verify/codec pipeline (server/hostpipe.py): pool
+round-trips, sticky routing, crash semantics, verify fan-out, and the
+grapevine_host_* telemetry leak policy."""
+
+import os
+import signal
+import time
+
+import grpc
+import pytest
+
+from grapevine_tpu.config import GrapevineConfig
+from grapevine_tpu.obs import TelemetryRegistry
+from grapevine_tpu.server.client import GrapevineClient
+from grapevine_tpu.server.hostpipe import (
+    HostAuthError,
+    HostPipeline,
+    HostWorkerCrash,
+)
+from grapevine_tpu.server.service import GrapevineServer
+from grapevine_tpu.session import get_signature_scheme, schnorrkel
+from grapevine_tpu.session.chacha import ChallengeRng
+from grapevine_tpu.session.channel import (
+    client_finish,
+    client_handshake,
+    server_handshake,
+)
+from grapevine_tpu.wire import constants as C
+from grapevine_tpu.wire.records import QueryRequest, RequestRecord
+
+CFG = GrapevineConfig(
+    bucket_cipher_rounds=0, max_messages=64, max_recipients=8,
+    mailbox_cap=8, batch_size=4, stash_size=64,
+)
+
+
+def pl(text: bytes) -> bytes:
+    return text.ljust(C.PAYLOAD_SIZE, b"\x00")
+
+
+def _wait_until(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- pool unit tests (no engine, no gRPC) ------------------------------
+
+
+@pytest.fixture(scope="module")
+def pool():
+    reg = TelemetryRegistry()
+    p = HostPipeline(2, registry=reg)
+    p.test_registry = reg
+    yield p
+    p.close()
+
+
+def _attached_session(pool, cid=b"C" * 16, seed=b"\x07" * 32):
+    """Handshake a channel pair and attach the server side to the pool;
+    returns (client_channel, seed)."""
+    state, msg1 = client_handshake()
+    reply, server_chan = server_handshake(msg1)
+    client_chan = client_finish(state, reply)
+    idx, epoch = pool.attach_session(cid, server_chan, seed)
+    assert idx == pool.worker_for(cid)
+    assert epoch == pool.epoch_of(idx)
+    return client_chan, seed
+
+
+def _signed_request(challenge):
+    sk, _ = schnorrkel.expand_mini_secret(b"\x01" * 32)
+    pub = schnorrkel.public_key(sk)
+    sig = schnorrkel.sign(
+        sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, challenge
+    )
+    return QueryRequest(
+        request_type=C.REQUEST_TYPE_CREATE,
+        auth_identity=pub,
+        auth_signature=sig,
+        record=RequestRecord(recipient=pub, payload=pl(b"via-pool")),
+    )
+
+
+def test_sticky_routing_is_public_and_deterministic(pool):
+    import hashlib
+
+    for cid in (b"a" * 16, b"b" * 16, os.urandom(16)):
+        want = int.from_bytes(
+            hashlib.sha256(cid).digest()[:8], "big"
+        ) % pool.workers
+        assert pool.worker_for(cid) == want
+        assert pool.worker_for(cid) == pool.worker_for(cid)
+
+
+def test_open_seal_roundtrip_preserves_lockstep(pool):
+    cid = b"R" * 16
+    client_chan, seed = _attached_session(pool, cid=cid)
+    rng = ChallengeRng(seed)  # the client's mirror of the lockstep
+    for i in range(3):
+        expected = rng.next_challenge()
+        req = _signed_request(expected)
+        ct = client_chan.encrypt(req.pack())
+        got_req, got_challenge = pool.open_request(cid, ct, b"")
+        assert got_challenge == expected
+        assert got_req.pack() == req.pack()
+        sealed = pool.seal_response(cid, b"resp-%d" % i)
+        assert client_chan.decrypt(sealed) == b"resp-%d" % i
+
+
+def test_injected_garbage_fails_without_desync(pool):
+    """AEAD failure inside a worker must not advance cipher state or
+    consume a challenge — the injection-DoS immunity of the in-process
+    path (service._query) carries over to the pool."""
+    cid = b"I" * 16
+    client_chan, seed = _attached_session(pool, cid=cid)
+    rng = ChallengeRng(seed)
+    with pytest.raises(HostAuthError):
+        pool.open_request(cid, b"\x13" * 128, b"")
+    # the session still works and the challenge stream was not consumed
+    expected = rng.next_challenge()
+    req = _signed_request(expected)
+    _, got = pool.open_request(cid, client_chan.encrypt(req.pack()), b"")
+    assert got == expected
+    sealed = pool.seal_response(cid, b"still-synced")
+    assert client_chan.decrypt(sealed) == b"still-synced"
+
+
+def test_unknown_channel_is_auth_error(pool):
+    with pytest.raises(HostAuthError):
+        pool.open_request(b"\xee" * 16, b"x" * 64, b"")
+
+
+def test_verify_parallel_good_and_bad(pool):
+    scheme = get_signature_scheme("schnorrkel")
+    items = []
+    for i in range(8):
+        sk, _ = scheme.expand_mini_secret(bytes([i + 1]) * 32)
+        msg = b"challenge-%d" % i
+        items.append((
+            scheme.public_key(sk),
+            C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT,
+            msg,
+            scheme.sign(sk, C.GRAPEVINE_CHALLENGE_SIGNING_CONTEXT, msg),
+        ))
+    assert pool.verify_parallel(items) is True
+    assert pool.verify_parallel([]) is True
+    bad = list(items)
+    bad[3] = (bad[3][0], bad[3][1], bad[3][2], b"\x00" * 64)
+    assert pool.verify_parallel(bad) is False
+
+
+def test_host_telemetry_families_registered(pool):
+    reg = pool.test_registry
+    for fam in ("grapevine_host_workers", "grapevine_host_workers_alive",
+                "grapevine_host_inflight_tasks",
+                "grapevine_host_tasks_total",
+                "grapevine_host_worker_crash_total"):
+        assert reg.get(fam) is not None, fam
+    assert reg.get("grapevine_host_workers").get() == 2
+    # the pool has served tasks above; phase/worker label values are the
+    # declared enumerations only, and the whole registry audits clean
+    assert reg.audit()["ok"]
+
+
+def test_worker_label_rejects_channel_ids():
+    """Teeth: a channel_id (or anything non-index) as a `worker` label
+    value must raise TelemetryLeakError at registration — the declared-
+    values-only policy is what keeps the worker key safe to allow."""
+    from grapevine_tpu.obs.registry import TelemetryLeakError
+
+    reg = TelemetryRegistry()
+    with pytest.raises(TelemetryLeakError):
+        reg.counter("bad_host_counter", "x",
+                    labels={"worker": ("deadbeef" * 4,)})
+    with pytest.raises(TelemetryLeakError):
+        reg.counter("bad_host_counter2", "x", labels={"worker": ("w0",)})
+
+
+def test_crash_fails_inflight_and_bumps_epoch():
+    """Kill a worker: in-flight tasks fail with HostWorkerCrash, the
+    epoch bumps (stale sessions can never resume), crash listeners get
+    the index, and without restart_on_crash the pool reads degraded."""
+    pool = HostPipeline(2)
+    try:
+        crashed = []
+        pool.on_crash(crashed.append)
+        cid = b"K" * 16
+        _attached_session(pool, cid=cid)
+        idx = pool.worker_for(cid)
+        epoch0 = pool.epoch_of(idx)
+        pid = pool.call("ping", None, sticky=cid)
+        os.kill(pid, signal.SIGKILL)
+        _wait_until(lambda: pool.crash_count >= 1, what="crash detection")
+        assert pool.epoch_of(idx) == epoch0 + 1
+        assert crashed == [idx]
+        assert not pool.alive()
+        # sticky submits to the dead worker fail loudly and immediately
+        with pytest.raises(HostWorkerCrash):
+            pool.call("ping", None, sticky=cid)
+    finally:
+        pool.close()
+
+
+def test_crash_with_restart_respawns_fresh_worker():
+    pool = HostPipeline(1, restart_on_crash=True)
+    try:
+        pid = pool.call("ping", None)
+        os.kill(pid, signal.SIGKILL)
+        _wait_until(lambda: pool.crash_count >= 1, what="crash detection")
+        _wait_until(pool.alive, what="respawn")
+        pid2 = pool.call("ping", None)
+        assert pid2 != pid
+        # the respawned worker has an empty session map: a stale session
+        # reads as unknown-channel (auth error), never a desynced decrypt
+        with pytest.raises(HostAuthError):
+            pool.open_request(b"S" * 16, b"x" * 64, b"")
+    finally:
+        pool.close()
+
+
+# -- end-to-end through GrapevineServer --------------------------------
+
+
+@pytest.fixture(scope="module")
+def host_server():
+    srv = GrapevineServer(
+        CFG, seed=2, max_wait_ms=5.0, clock=lambda: 1_700_000_000,
+        host_workers=2, worker_restart=True,
+    )
+    port = srv.start("insecure-grapevine://127.0.0.1:0")
+    yield srv, port
+    srv.stop()
+
+
+def make_client(port, seed_byte):
+    c = GrapevineClient(
+        f"insecure-grapevine://127.0.0.1:{port}",
+        identity_seed=bytes([seed_byte]) * 32,
+    )
+    c.auth()
+    return c
+
+
+def test_end_to_end_crud_through_pool(host_server):
+    srv, port = host_server
+    assert srv.hostpipe is not None and srv.scheduler.hostpipe is srv.hostpipe
+    alice = make_client(port, 11)
+    bob = make_client(port, 12)
+    r = alice.create(bob.public_key, pl(b"hello through the pool"))
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    r = bob.read()
+    assert r.status_code == C.STATUS_CODE_SUCCESS
+    assert r.record.payload.startswith(b"hello through the pool")
+    assert r.record.sender == alice.public_key
+    # lockstep survives a long run of queries through the worker
+    for i in range(10):
+        assert alice.read().status_code in (
+            C.STATUS_CODE_SUCCESS, C.STATUS_CODE_NOT_FOUND
+        )
+    # sessions carry their sticky worker assignment
+    with srv._sessions_lock:
+        for s in srv._sessions.values():
+            assert s.worker is not None
+            assert 0 <= s.worker < 2
+    for c in (alice, bob):
+        c.close()
+
+
+def test_bad_signature_rejected_through_pool(host_server):
+    """The scheduler's verify fan-out (hostpipe.verify_parallel) must
+    reject a garbage challenge signature exactly like the in-process
+    MSM: UNAUTHENTICATED, and the session keeps working."""
+    import types
+
+    _, port = host_server
+    c = make_client(port, 13)
+    good_scheme = c._scheme
+    c._scheme = types.SimpleNamespace(
+        sign=lambda sk, ctx, msg: b"\x00" * C.SIGNATURE_SIZE,
+    )
+    with pytest.raises(grpc.RpcError) as exc:
+        c.read()
+    assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    # the challenge WAS consumed (verification happens after the draw,
+    # like in-process); with the real scheme back the lockstep matches
+    c._scheme = good_scheme
+    assert c.read().status_code in (
+        C.STATUS_CODE_SUCCESS, C.STATUS_CODE_NOT_FOUND
+    )
+    c.close()
+
+
+def test_worker_crash_drops_sessions_and_reauth_recovers(host_server):
+    srv, port = host_server
+    c = make_client(port, 14)
+    assert c.read().status_code in (
+        C.STATUS_CODE_SUCCESS, C.STATUS_CODE_NOT_FOUND
+    )
+    cid = c._channel_id
+    idx = srv.hostpipe.worker_for(cid)
+    crash0 = srv.hostpipe.crash_count
+    pid = srv.hostpipe.call("ping", None, sticky=cid)
+    os.kill(pid, signal.SIGKILL)
+    _wait_until(lambda: srv.hostpipe.crash_count > crash0,
+                what="crash detection")
+    # the crash listener dropped every session stuck to that worker
+    with srv._sessions_lock:
+        assert all(
+            s.worker != idx or s.worker_epoch == srv.hostpipe.epoch_of(idx)
+            for s in srv._sessions.values()
+        )
+    with pytest.raises(grpc.RpcError) as exc:
+        c.read()
+    assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    # worker_restart=True: the pool respawns and a fresh auth serves
+    _wait_until(srv.hostpipe.alive, what="respawn")
+    c.auth()
+    assert c.read().status_code in (
+        C.STATUS_CODE_SUCCESS, C.STATUS_CODE_NOT_FOUND
+    )
+    c.close()
+
+
+def test_healthz_folds_hostpipe(host_server):
+    srv, _ = host_server
+    _wait_until(srv.hostpipe.alive, what="pool alive")
+    healthy, detail = srv.healthz()
+    assert detail["host_workers"] == 2
+    assert detail["host_workers_alive"] == 2
+    assert healthy
+
+
+def test_host_telemetry_on_server_registry(host_server):
+    srv, _ = host_server
+    reg = srv.metrics_registry
+    assert reg.get("grapevine_host_workers").get() == 2
+    tasks = reg.get("grapevine_host_tasks_total")
+    served = sum(
+        child.value for _, child in tasks.series()
+    )
+    assert served > 0
+    assert reg.audit()["ok"]

@@ -9,15 +9,10 @@ static is rejected before any frame flows.
 
 import pytest
 
-pytest.importorskip(
-    "cryptography",
-    reason="session channel layer needs the cryptography wheel "
-    "(absent in some CI containers) — skip, not a collection error",
-)
-
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
-
 from grapevine_tpu.session import channel
+# whichever backend channel.py loaded (the wheel, or the stdlib port in
+# wheel-less containers) — the handshake properties must hold on both
+from grapevine_tpu.session.channel import X25519PrivateKey
 
 
 def _full_handshake(client_static=None, attestation=None, pin=None,

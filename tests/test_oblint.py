@@ -439,6 +439,33 @@ class BatchScheduler:
     ), vs
 
 
+def test_locklint_covers_hostpipe_handoff():
+    """The multiprocess host pipeline's main-side hand-off (ISSUE 20)
+    is in coverage: a HostPipeline whose reader thread and submitters
+    race on an unlocked attribute must be flagged like the scheduler's."""
+    piped = _FAKE_OK + '''
+class HostPipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def _start(self):
+        self._reader = threading.Thread(target=self._read_loop)
+
+    def _read_loop(self):
+        self._inflight = self._inflight - 1  # reader write, no lock
+
+    def submit(self, task):
+        self._inflight = self._inflight + 1  # caller write, no lock
+        return self._inflight
+'''
+    vs = lint_sources({"fake.py": piped}, allow=())
+    assert any(
+        v.kind == "shared-attr" and "HostPipeline._inflight" in v.where
+        for v in vs
+    ), vs
+
+
 def test_locklint_missing_code_is_loud():
     vs = lint_sources({"fake.py": "x = 1\n"}, allow=())
     assert any(v.kind == "missing-code" for v in vs)

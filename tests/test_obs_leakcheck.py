@@ -111,6 +111,36 @@ def test_telemetry_policy_checker_clean():
     assert mod.audit_shipped_registry()["ok"]
 
 
+def test_host_namespace_audit_and_teeth():
+    """The host serving pipeline's namespace audit (ISSUE-20 satellite):
+    ``audit_host_registry`` builds the real HostPipeline + adaptive
+    policy + flush-windowed scheduler against one registry and passes —
+    and the teeth it relies on bite here directly: a channel-id-valued
+    ``worker`` label (the exact identity the sticky channel→worker
+    routing could be tempted to export) raises TelemetryLeakError at
+    registration, as does a ``channel_id`` label key."""
+    path = os.path.join(REPO, "tools", "check_telemetry_policy.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_policy_host", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.audit_host_registry()
+    assert report["ok"] and report["host_families"] >= 9
+
+    reg = TelemetryRegistry()
+    with pytest.raises(TelemetryLeakError):
+        reg.counter(
+            "grapevine_host_tasks_total", "t",
+            labels={"worker": ("deadbeef" * 4,)},
+        )
+    with pytest.raises(TelemetryLeakError):
+        reg.counter(
+            "grapevine_host_tasks_total", "t",
+            labels={"channel_id": ("0",)},
+        )
+
+
 # -- exposition format -------------------------------------------------
 
 

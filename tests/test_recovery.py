@@ -416,7 +416,8 @@ def test_engine_tier_drain_maps_to_unavailable_and_health_surfaces():
 
 @pytest.mark.parametrize("argv", [
     ["--role", "frontend", "--engine", "h:1", "--state-dir", "/tmp/x"],
-    ["--role", "frontend", "--engine", "h:1", "--worker-restart"],
+    ["--role", "frontend", "--engine", "h:1",
+     "--journal-fsync-every", "4"],
     ["--role", "frontend", "--engine", "h:1",
      "--checkpoint-every-rounds", "8"],
 ])

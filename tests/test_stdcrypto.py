@@ -1,0 +1,224 @@
+"""Stdlib channel-crypto backend (session/stdcrypto.py): RFC vectors and
+bit-compatibility pins.
+
+The wheel-less backend must produce the *same bytes* as the
+``cryptography``-backed channel, or a stdlib client could not talk to a
+wheel-backed server. Each primitive is pinned to its RFC test vector
+(the same vectors the wheel's implementations are certified against —
+two implementations that both match the RFC match each other), and when
+the wheel happens to be present in the container, directly against the
+wheel's output inside the same always-running tests (plain ``if``, not
+a skip: the wheel-less container must exercise every line here)."""
+
+import hashlib
+import os
+
+import pytest
+
+from grapevine_tpu.session import chacha, channel, stdcrypto
+
+try:
+    import cryptography  # noqa: F401
+
+    HAVE_WHEEL = True
+except ModuleNotFoundError:
+    HAVE_WHEEL = False
+
+
+# -- ChaCha20 -----------------------------------------------------------
+
+
+def test_chacha20_rfc8439_block_and_stream():
+    """RFC 8439 §2.3.2 (block) / §2.4.2 (encryption) vectors, plus the
+    numpy stream pinned to the pure-Python spec oracle."""
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = stdcrypto.chacha20_xor(key, nonce, pt, counter=1)
+    assert ct[:16] == bytes.fromhex("6e2e359a2568f98041ba0728dd0d6981")
+    assert ct[-14:] == bytes.fromhex("74a35be6b40b8eedf2785e42874d")
+    assert stdcrypto.chacha20_xor(key, nonce, ct, counter=1) == pt
+    # numpy stream == pure-Python block oracle, arbitrary counter
+    pure = chacha.ChaCha20(key, nonce, counter=7)
+    want = b"".join(pure._block(7 + i) for i in range(3))
+    assert stdcrypto.chacha20_keystream(key, nonce, 192, counter=7) == want
+
+
+def test_challenge_rng_uses_same_stream_regardless_of_backend():
+    """ChallengeRng draws are the cross-implementation contract
+    (README.md:189-196) — the keystream fallback must not change them."""
+    seed = bytes(range(32))
+    rng = chacha.ChallengeRng(seed)
+    draws = [rng.next_challenge() for _ in range(4)]
+    # spec oracle: block function at counters 0..1 (4 × 32 bytes)
+    oracle = chacha.ChaCha20(seed)
+    want = b"".join(oracle._block(i) for i in range(2))
+    assert b"".join(draws) == want
+
+
+# -- Poly1305 / AEAD ----------------------------------------------------
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a8"
+        "0103808afb0db2fd4abff6af4149f51b"
+    )
+    msg = b"Cryptographic Forum Research Group"
+    assert stdcrypto.poly1305(key, msg) == bytes.fromhex(
+        "a8061dc1305136c6c22b8baf0c0127a9"
+    )
+
+
+def test_chacha20poly1305_rfc8439_vector_and_wheel_compat():
+    """RFC 8439 §2.8.2 AEAD vector; when the wheel is present, also pin
+    byte-equality against its ChaCha20Poly1305."""
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    out = stdcrypto.ChaCha20Poly1305(key).encrypt(nonce, pt, aad)
+    ct, tag = out[:-16], out[-16:]
+    assert ct[:16] == bytes.fromhex("d31a8d34648e60db7b86afbc53ef7ec2")
+    assert tag == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert stdcrypto.ChaCha20Poly1305(key).decrypt(nonce, out, aad) == pt
+    if HAVE_WHEEL:
+        from cryptography.hazmat.primitives.ciphers.aead import (
+            ChaCha20Poly1305 as WheelAEAD,
+        )
+
+        assert WheelAEAD(key).encrypt(nonce, pt, aad) == out
+        assert WheelAEAD(key).decrypt(nonce, out, aad) == pt
+
+
+def test_chacha20poly1305_rejects_tampering():
+    key = os.urandom(32)
+    nonce = os.urandom(12)
+    aead = stdcrypto.ChaCha20Poly1305(key)
+    out = aead.encrypt(nonce, b"payload", b"aad")
+    for mutate in (
+        lambda b: bytes([b[0] ^ 1]) + b[1:],          # ciphertext bit
+        lambda b: b[:-1] + bytes([b[-1] ^ 1]),        # tag bit
+        lambda b: b[:8],                              # truncation
+    ):
+        with pytest.raises(stdcrypto.InvalidTag):
+            aead.decrypt(nonce, mutate(out), b"aad")
+    with pytest.raises(stdcrypto.InvalidTag):
+        aead.decrypt(nonce, out, b"other aad")
+
+
+# -- X25519 -------------------------------------------------------------
+
+
+def test_x25519_rfc7748_vectors():
+    # RFC 7748 §5.2 vector 1
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    assert stdcrypto.x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    # RFC 7748 §6.1 Diffie-Hellman vector
+    a = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    b = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    a_pub = stdcrypto.X25519PrivateKey(a).public_key().public_bytes_raw()
+    b_pub = stdcrypto.X25519PrivateKey(b).public_key().public_bytes_raw()
+    assert a_pub == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert b_pub == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    k_a = stdcrypto.X25519PrivateKey(a).exchange(
+        stdcrypto.X25519PublicKey(b_pub)
+    )
+    k_b = stdcrypto.X25519PrivateKey(b).exchange(
+        stdcrypto.X25519PublicKey(a_pub)
+    )
+    assert k_a == k_b == shared
+    if HAVE_WHEEL:
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey as WheelPriv,
+            X25519PublicKey as WheelPub,
+        )
+
+        assert (
+            WheelPriv.from_private_bytes(a)
+            .exchange(WheelPub.from_public_bytes(b_pub))
+            == shared
+        )
+
+
+def test_x25519_rejects_all_zero_secret():
+    # the all-zero point is low-order: exchange must refuse it, like the
+    # wheel's contributory-behavior check
+    priv = stdcrypto.X25519PrivateKey.generate()
+    with pytest.raises(ValueError):
+        priv.exchange(stdcrypto.X25519PublicKey(b"\x00" * 32))
+
+
+# -- HKDF ---------------------------------------------------------------
+
+
+def test_hkdf_rfc5869_case1_and_wheel_compat():
+    ikm = b"\x0b" * 22
+    salt = bytes(range(13))
+    info = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9")
+    okm = stdcrypto.hkdf_sha256(ikm, salt, info, 42)
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a"
+        "2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865"
+    )
+    if HAVE_WHEEL:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+        assert (
+            HKDF(algorithm=hashes.SHA256(), length=42, salt=salt, info=info)
+            .derive(ikm)
+            == okm
+        )
+
+
+# -- channel integration ------------------------------------------------
+
+
+def test_channel_backend_is_declared_and_handshake_works():
+    """Whichever backend loaded, a full IX handshake + framed traffic
+    must work — this is the line `server_loopback` and the session test
+    modules now rely on in wheel-less containers."""
+    assert channel.CRYPTO_BACKEND in ("cryptography", "stdlib")
+    if not HAVE_WHEEL:
+        assert channel.CRYPTO_BACKEND == "stdlib"
+    ident = channel.ServerIdentity.from_seed(b"\x07" * 32)
+    state, msg1 = channel.client_handshake()
+    reply, server_chan = channel.server_handshake(msg1, identity=ident)
+    client_chan = channel.client_finish(
+        state, reply, expected_server_static=ident.public
+    )
+    for i in range(3):
+        msg = hashlib.sha256(bytes([i])).digest()
+        assert server_chan.decrypt(client_chan.encrypt(msg, b"a"), b"a") == msg
+        assert client_chan.decrypt(server_chan.encrypt(msg)) == msg
+    # tamper → AEAD failure, whatever exception class the backend uses
+    ct = bytearray(client_chan.encrypt(b"x"))
+    ct[0] ^= 1
+    with pytest.raises(Exception):
+        server_chan.decrypt(bytes(ct))

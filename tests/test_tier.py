@@ -9,12 +9,6 @@ from __future__ import annotations
 import grpc
 import pytest
 
-pytest.importorskip(
-    "cryptography",
-    reason="session channel layer needs the cryptography wheel "
-    "(absent in some CI containers) — skip, not a collection error",
-)
-
 from grapevine_tpu.config import GrapevineConfig
 from grapevine_tpu.server.client import GrapevineClient
 from grapevine_tpu.server.tier import ENGINE_SERVICE_NAME, EngineServer, FrontendServer

@@ -64,7 +64,9 @@ LOWER_BETTER = ("_ms", "_ms_per_op", "_s")
 GEOMETRY_KEYS = ("batch", "capacity_log2", "mesh", "clients",
                  "tree_density", "key_bits", "radix_bits_per_pass",
                  "rounds", "slo_target_ms", "pipeline_depth",
-                 "evict_every", "shard_count", "tail_frames")
+                 "evict_every", "shard_count", "tail_frames",
+                 "worker_count", "adaptive_batch", "crypto_backend",
+                 "host_cores", "verify_items")
 
 #: result fields that are neither geometry nor a directional metric.
 #: dispatch_skew_p99_ms is the load harness's HONESTY metric (how late
@@ -351,6 +353,39 @@ def selftest(factor: float) -> None:
     assert n == 3 and len(regs) == 3, (
         f"sentinel self-test: same-tail-frames series not gated "
         f"({n=}, {regs})"
+    )
+    # worker_count is GEOMETRY (ISSUE 20, bench host_pipeline_ab): a
+    # W-worker multiprocess frontend runs a different host program
+    # (fan-out + IPC) than the in-process path — its numbers key their
+    # own series in either direction; same-W lines must still gate.
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    b["configs"]["load_scenarios"]["worker_count"] = 2
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: a worker_count-keyed host-pipeline line "
+        "was compared against the in-process baseline"
+    )
+    i = mk_cap(200.0, 40.0, 3250.7)
+    j = mk_cap(200.0 / (factor * 4.0), 40.0 * factor * 4.0, 3250.7)
+    i["configs"]["load_scenarios"]["worker_count"] = 2
+    j["configs"]["load_scenarios"]["worker_count"] = 2
+    regs, n = compare_latest(extract_series([i, j]), factor)
+    assert n == 3 and len(regs) == 3, (
+        f"sentinel self-test: same-worker-count series not gated "
+        f"({n=}, {regs})"
+    )
+    # adaptive_batch is GEOMETRY (ISSUE 20): the SLO-adaptive window
+    # trades latency against occupancy per-round — a run with the
+    # policy on measures a different collection discipline than the
+    # static window and must never grade against it.
+    a = mk_cap(200.0, 40.0, 3250.7)
+    b = mk_cap(200.0 * factor * 4.0, 40.0 / (factor * 4.0), 3250.7)
+    b["configs"]["load_scenarios"]["adaptive_batch"] = True
+    regs, n = compare_latest(extract_series([a, b]), factor)
+    assert n == 0 and not regs, (
+        "sentinel self-test: an adaptive-batch line was compared "
+        "against the static-window baseline"
     )
 
 

@@ -544,6 +544,130 @@ def audit_cost_registry() -> dict:
     return report
 
 
+def audit_host_registry() -> dict:
+    """Runtime pass over the host serving pipeline's metric namespace
+    (ISSUE-20 satellite — the ``grapevine_host_*`` families from the
+    multiprocess verify/codec pool, the SLO-adaptive window policy, and
+    the flush-aware collection stretch):
+
+    - builds the registry exactly as the serving layer does — a real
+      ``HostPipeline`` (worker processes spawned, then closed), a real
+      ``AdaptiveBatchPolicy``, and a flush-windowed ``BatchScheduler``
+      all registering into one merged registry, as /metrics serves it;
+    - the ONLY label keys anywhere in the namespace are ``phase``
+      (declared task kinds / decision kinds — fixed vocabularies) and
+      ``worker`` (pool indices declared at registration from the
+      --host-workers config: public topology, never identity);
+    - ``worker`` values are exactly the configured pool's digit
+      strings — many channels hash onto one worker and the mapping is
+      never exported, so the index reveals pool size only;
+    - teeth: a channel-id-shaped ``worker`` value, a non-digit worker
+      name, and a ``channel_id`` label key each raise
+      TelemetryLeakError at registration — the sticky-routing design
+      (sessions pinned to workers by channel hash) is precisely where
+      a per-channel dimension would be tempting, so the rule is
+      enforcement, not convention.
+    """
+    sys.path.insert(0, REPO)
+    from grapevine_tpu.server.adaptive import (
+        DECISION_KINDS,
+        AdaptiveBatchPolicy,
+    )
+    from grapevine_tpu.server.hostpipe import TASK_KINDS, HostPipeline
+    from grapevine_tpu.server.scheduler import BatchScheduler
+    from grapevine_tpu.obs.registry import (
+        TelemetryLeakError,
+        TelemetryRegistry,
+    )
+
+    reg = TelemetryRegistry()
+    pipe = HostPipeline(workers=2, registry=reg)
+    sched = None
+    try:
+        AdaptiveBatchPolicy(8, 0.008, 0.002, registry=reg)
+
+        class _Ecfg:
+            batch_size = 8
+
+        class _Metrics:
+            registry = reg
+
+        class _Engine:
+            ecfg = _Ecfg()
+            metrics = _Metrics()
+
+        sched = BatchScheduler(_Engine(), flush_window_ms=4.0)
+    finally:
+        if sched is not None:
+            sched.close()
+        pipe.close()
+    report = reg.audit()  # raises on any violation
+
+    families = [
+        m for m in reg.collect() if m.name.startswith("grapevine_host_")
+    ]
+    if len(families) < 9:
+        raise SystemExit(
+            "host namespace missing: serving layer registered only "
+            f"{[m.name for m in families]}"
+        )
+    for m in families:
+        bad = set(m.label_keys) - {"phase", "worker"}
+        if bad:
+            raise SystemExit(
+                f"host metric {m.name!r} carries label keys "
+                f"{sorted(bad)} — 'phase' and 'worker' are the only "
+                "permitted keys in the grapevine_host_* namespace"
+            )
+        for v in m.labels_decl.get("worker", ()):
+            if not v.isdigit():
+                raise SystemExit(
+                    f"host metric {m.name!r} declares worker value "
+                    f"{v!r} — worker values must be pool indices "
+                    "(digit strings), never names or identities"
+                )
+    tasks = reg.get("grapevine_host_tasks_total")
+    if tasks is None or tuple(tasks.labels_decl["worker"]) != ("0", "1"):
+        raise SystemExit(
+            "grapevine_host_tasks_total worker values drifted from the "
+            "configured pool indices"
+        )
+    for v in tasks.labels_decl["phase"]:
+        if v not in TASK_KINDS:
+            raise SystemExit(
+                f"grapevine_host_tasks_total declares phase {v!r} — "
+                f"values must be the fixed task kinds {TASK_KINDS}"
+            )
+    dec = reg.get("grapevine_host_adaptive_decisions_total")
+    if dec is None:
+        raise SystemExit("adaptive decision counter missing")
+    for v in dec.labels_decl["phase"]:
+        if v not in DECISION_KINDS:
+            raise SystemExit(
+                f"adaptive decision counter declares phase {v!r} — "
+                f"values must be the fixed decision kinds "
+                f"{DECISION_KINDS}"
+            )
+
+    # teeth: a channel identity can never ride the worker dimension
+    r = TelemetryRegistry()
+    for labels, why in (
+        ({"worker": ("deadbeef" * 4,)}, "channel-id-shaped worker value"),
+        ({"worker": ("w0",)}, "non-digit worker value"),
+        ({"channel_id": ("0",)}, "'channel_id' label key"),
+    ):
+        try:
+            r.counter("grapevine_host_teeth_probe", "probe", labels=labels)
+        except TelemetryLeakError:
+            continue
+        raise SystemExit(
+            f"host label policy has no teeth: {why} was accepted at "
+            "registration"
+        )
+    report["host_families"] = len(families)
+    return report
+
+
 def main() -> int:
     violations = scan_call_sites()
     for v in violations:
@@ -555,6 +679,7 @@ def main() -> int:
     audit_evict_registry()
     fl_report = audit_fleet_registry()
     cost_report = audit_cost_registry()
+    host_report = audit_host_registry()
     print(
         f"telemetry policy: static scan "
         f"{'FAILED' if violations else 'clean'}; registry audit ok "
@@ -568,7 +693,9 @@ def main() -> int:
         f"fleet audit ok ({fl_report['fleet_families']} families, "
         "shard-only integer labels, teeth); cost audit ok "
         f"({cost_report['cost_families']} families, phase-only labels, "
-        "fixed schedule values, teeth)"
+        "fixed schedule values, teeth); host audit ok "
+        f"({host_report['host_families']} families, phase/worker-only "
+        "labels, digit worker indices, teeth)"
     )
     return 1 if violations else 0
 
